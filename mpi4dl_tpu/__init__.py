@@ -1,0 +1,25 @@
+"""mpi4dl_tpu — a TPU-native framework with the capabilities of MPI4DL.
+
+MPI4DL (reference: /root/reference, the OSU ``torchgems`` package) trains
+out-of-core CNNs on very-high-resolution images by composing five parallelism
+dimensions: Layer (LP), Pipeline (PP), Spatial (SP, image-tile sharding with
+halo exchange), Data (DP), and GEMS bidirectional parallelism.
+
+This package re-designs those capabilities TPU-first:
+
+- one ``jax.sharding.Mesh`` with axes ``("data", "pipe", "tile_h", "tile_w")``
+  replaces the reference's MPI process groups (``src/torchgems/comm.py``);
+- the LP/PP send/recv pipeline (``src/torchgems/mp_pipeline.py``) becomes a
+  collective-permute GPipe schedule inside one jitted SPMD program
+  (:mod:`mpi4dl_tpu.parallel.pipeline`);
+- halo-exchange spatial convolution (``src/torchgems/spatial.py``) becomes
+  ``shard_map`` + ``lax.ppermute`` neighbor shifts (:mod:`mpi4dl_tpu.ops.spatial`);
+- GEMS-MASTER (``src/torchgems/gems_master.py``) becomes a mirrored dual
+  pipeline in the same program (:mod:`mpi4dl_tpu.parallel.gems`);
+- gradient sync (``SyncAllreduce``) becomes ``psum`` over mesh axes.
+"""
+
+__version__ = "0.1.0"
+
+from mpi4dl_tpu import utils  # noqa: F401
+from mpi4dl_tpu.config import ParallelConfig  # noqa: F401

@@ -1,0 +1,169 @@
+"""Parallelism configuration + device mesh factory.
+
+This is the TPU-native replacement for the reference's ``MPIComm``
+(``src/torchgems/comm.py:44-309``) and ``verify_spatial_config``
+(``src/torchgems/train_spatial.py:33-58``). Instead of MPI process groups we
+build one ``jax.sharding.Mesh`` with axes ``("data", "pipe", "tile_h",
+"tile_w")``:
+
+- ``data``   — data-parallel replicas (ref ``create_allreduce_comm_basic``);
+- ``pipe``   — pipeline/layer-parallel stages (ref linear send/recv topology,
+  ``mp_pipeline.py:238-248``);
+- ``tile_h`` / ``tile_w`` — spatial image tiling (ref ``num_spatial_parts``;
+  square → 2-D grid, vertical → tile_w only, horizontal → tile_h only, per
+  ``split_input`` ``train_spatial.py:241-290``).
+
+Device-count mapping note: the reference uses ``mp_size = num_spatial_parts +
+(split_size - 1)`` ranks (spatial stage is "wide", later LP stages use one GPU
+each, ``comm.py:59-67``). A TPU mesh is rectangular, so we use ``pipe ×
+tile_h × tile_w`` devices per replica; non-spatial stages run replicated over
+the tile axes, or batch-sharded over them when ``local_dp > 1`` (the
+reference's LOCAL_DP_LP, ``train_spatial.py:809-1028``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from mpi4dl_tpu.utils import is_power_two
+
+SLICE_SQUARE = "square"
+SLICE_VERTICAL = "vertical"
+SLICE_HORIZONTAL = "horizontal"
+SLICE_METHODS = (SLICE_SQUARE, SLICE_VERTICAL, SLICE_HORIZONTAL)
+
+# Canonical mesh axis names, used across the package.
+AXIS_DATA = "data"
+AXIS_PIPE = "pipe"
+AXIS_TILE_H = "tile_h"
+AXIS_TILE_W = "tile_w"
+
+
+def tile_grid(num_spatial_parts: int, slice_method: str) -> tuple[int, int]:
+    """(tile_h, tile_w) grid extents for one SP stage.
+
+    Mirrors the reference's neighbor model (``spatial.py:941-1017``): square
+    slices form a √p × √p grid, vertical slices split width only, horizontal
+    slices split height only.
+    """
+    if slice_method == SLICE_SQUARE:
+        side = int(math.isqrt(num_spatial_parts))
+        if side * side != num_spatial_parts:
+            raise ValueError(
+                f"square slicing needs a perfect-square part count, got {num_spatial_parts}"
+            )
+        return side, side
+    if slice_method == SLICE_VERTICAL:
+        return 1, num_spatial_parts
+    if slice_method == SLICE_HORIZONTAL:
+        return num_spatial_parts, 1
+    raise ValueError(f"slice_method must be one of {SLICE_METHODS}, got {slice_method!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Full parallelism plan for one training run.
+
+    Field names follow the reference CLI (``parser.py:21-143``) so benchmark
+    scripts translate flag-for-flag.
+    """
+
+    batch_size: int = 32
+    parts: int = 1  # micro-batches per pipeline step (GPipe fill-drain)
+    split_size: int = 2  # pipeline stages
+    num_spatial_parts: Sequence[int] = (4,)
+    spatial_size: int = 0  # how many leading stages are spatially partitioned
+    slice_method: str = SLICE_SQUARE
+    times: int = 1  # GEMS replication factor
+    image_size: int = 32
+    num_classes: int = 10
+    balance: Sequence[int] | None = None
+    local_dp: int = 1
+    halo_d2: bool = False
+    fused_layers: int = 1
+    data_parallel: int = 1
+    precision: str = "bf16"
+
+    def __post_init__(self):
+        if isinstance(self.num_spatial_parts, int):
+            object.__setattr__(self, "num_spatial_parts", (self.num_spatial_parts,))
+        else:
+            object.__setattr__(self, "num_spatial_parts", tuple(self.num_spatial_parts))
+        if self.balance is not None:
+            object.__setattr__(self, "balance", tuple(self.balance))
+        self.validate()
+
+    # -- validation (parity with verify_spatial_config, train_spatial.py:33-58)
+    def validate(self) -> None:
+        if self.parts < 1 or self.split_size < 1:
+            raise ValueError("parts and split_size must be >= 1")
+        if self.batch_size % self.parts != 0:
+            raise ValueError("batch_size must divide evenly into `parts` micro-batches")
+        if self.spatial_size:
+            if self.slice_method not in SLICE_METHODS:
+                raise ValueError(f"slice_method must be one of {SLICE_METHODS}")
+            if not is_power_two(self.image_size):
+                raise ValueError("image size must be a power of two for SP")
+            if self.spatial_size > self.split_size:
+                raise ValueError("spatial_size cannot exceed split_size")
+            if len(self.num_spatial_parts) not in (1, self.spatial_size):
+                raise ValueError(
+                    "num_spatial_parts must have one entry or spatial_size entries"
+                )
+            for p in self.num_spatial_parts:
+                if not is_power_two(p):
+                    raise ValueError("each spatial part count must be a power of two")
+                th, tw = tile_grid(p, self.slice_method)
+                if self.image_size % th or self.image_size % tw:
+                    raise ValueError("image size must divide evenly into tiles")
+                if not (
+                    is_power_two(self.image_size // th)
+                    and is_power_two(self.image_size // tw)
+                ):
+                    raise ValueError("per-partition image size must be a power of two")
+        if self.balance is not None:
+            if len(self.balance) != self.split_size:
+                raise ValueError("balance list length must equal split_size")
+
+    # -- derived geometry ---------------------------------------------------
+    @property
+    def spatial_parts(self) -> int:
+        """Tile-device count (max over SP stages; uniform in round 1)."""
+        return max(self.num_spatial_parts) if self.spatial_size else 1
+
+    @property
+    def tile_shape(self) -> tuple[int, int]:
+        if not self.spatial_size:
+            return (1, 1)
+        return tile_grid(self.spatial_parts, self.slice_method)
+
+    @property
+    def mesh_shape(self) -> tuple[int, int, int, int]:
+        th, tw = self.tile_shape
+        return (self.data_parallel, self.split_size, th, tw)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh_shape))
+
+    def make_mesh(self, devices=None) -> Mesh:
+        """Build the 4-axis device mesh (replaces MPIComm group construction)."""
+        if devices is None:
+            devices = jax.devices()
+        n = self.num_devices
+        if len(devices) < n:
+            raise ValueError(
+                f"config needs {n} devices (mesh {self.mesh_shape}), "
+                f"have {len(devices)}"
+            )
+        dev = np.asarray(devices[:n]).reshape(self.mesh_shape)
+        return Mesh(dev, (AXIS_DATA, AXIS_PIPE, AXIS_TILE_H, AXIS_TILE_W))
+
+    def micro_batch_size(self) -> int:
+        return self.batch_size // self.parts

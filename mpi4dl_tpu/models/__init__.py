@@ -1,0 +1,1 @@
+from mpi4dl_tpu.models.resnet import get_resnet_v1, get_resnet_v2  # noqa: F401

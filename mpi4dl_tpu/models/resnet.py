@@ -1,0 +1,295 @@
+"""ResNet v1/v2 (Keras-style) — capability parity with reference
+``src/models/resnet.py`` (plain), ``resnet_spatial.py`` (spatial D1) and
+``resnet_spatial_d2.py`` (fused-halo D2), unified into one builder.
+
+The reference keeps three near-copies of the model, differing only in which
+conv class is instantiated; here every cell takes a ``spatial`` flag and the
+builder marks the first ``spatial_cells`` cells spatial (ref boundary logic:
+``resnet_spatial.py:545-633`` emits spatial cells for layers before the SP
+stage's end layer).
+
+Architecture parity (ref ``resnet.py``):
+- ``ResNetLayer`` == ``resnet_layer`` (``resnet.py:24-78``): conv→BN→ReLU or
+  BN→ReLU→conv (``conv_first``), k=3 default, padding (k-1)/2.
+- v1 cell == ``make_cell_v1`` (``resnet.py:81-114``): two 3×3 layers +
+  1×1-conv shortcut on stack transitions; out = relu(x + y).
+- v2 cell == ``make_cell_v2`` (``resnet.py:181-231``): pre-activation
+  bottleneck (3×3, 3×3, 1×1 — the reference's variant) + 1×1 shortcut on
+  each stack's first block.
+- builders == ``get_resnet_v1``/``get_resnet_v2`` (``resnet.py:145-178,
+  :270-323``): depth = 6n+2 / 9n+2, 3 stacks, stride-2 downsample at stack
+  starts, avg-pool-8 + linear head.
+
+Deliberate deviation: the reference head applies ``F.softmax`` and then
+feeds the result to ``nn.CrossEntropyLoss`` (a double-softmax —
+``resnet.py:140``). We output logits; the loss applies softmax once.
+
+Models are returned as a **list of cells** (tensor→tensor modules) so the
+stage partitioner can slice them, like the reference's flat
+``nn.Sequential(OrderedDict)`` sliced by child index (``mp_pipeline.py:71-83``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mpi4dl_tpu.ops.layers import Conv2d, Dense, Pool, TrainBatchNorm, TILE_AXES
+
+
+def _bn_axes(spatial: bool, cross_tile_bn: bool) -> tuple[str, ...]:
+    return TILE_AXES if (spatial and cross_tile_bn) else ()
+
+
+class ResNetLayer(nn.Module):
+    """conv/BN/ReLU unit (ref ``resnet_layer``, ``resnet.py:24-78``)."""
+
+    features: int
+    kernel_size: int = 3
+    strides: int = 1
+    activation: str | None = "relu"
+    batch_normalization: bool = True
+    conv_first: bool = True
+    spatial: bool = False
+    bn_reduce_axes: tuple[str, ...] = ()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        conv = Conv2d(
+            features=self.features,
+            kernel_size=self.kernel_size,
+            strides=self.strides,
+            spatial=self.spatial,
+            dtype=self.dtype,
+            name="conv",
+        )
+        bn = (
+            TrainBatchNorm(reduce_axes=self.bn_reduce_axes, dtype=self.dtype, name="bn")
+            if self.batch_normalization
+            else None
+        )
+        if self.conv_first:
+            x = conv(x)
+            if bn is not None:
+                x = bn(x)
+            if self.activation:
+                x = nn.relu(x)
+        else:
+            if bn is not None:
+                x = bn(x)
+            if self.activation:
+                x = nn.relu(x)
+            x = conv(x)
+        return x
+
+
+class CellV1(nn.Module):
+    """Basic residual cell (ref ``make_cell_v1``, ``resnet.py:81-114``)."""
+
+    stack: int
+    res_block: int
+    strides: int
+    features: int
+    spatial: bool = False
+    bn_reduce_axes: tuple[str, ...] = ()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        common = dict(
+            spatial=self.spatial, bn_reduce_axes=self.bn_reduce_axes, dtype=self.dtype
+        )
+        y = ResNetLayer(self.features, strides=self.strides, name="r1", **common)(x)
+        y = ResNetLayer(self.features, activation=None, name="r2", **common)(y)
+        if self.res_block == 0 and self.stack > 0:
+            x = ResNetLayer(
+                self.features,
+                kernel_size=1,
+                strides=self.strides,
+                activation=None,
+                batch_normalization=False,
+                name="r3",
+                **common,
+            )(x)
+        return nn.relu(x + y)
+
+
+class CellV2(nn.Module):
+    """Pre-activation bottleneck cell (ref ``make_cell_v2``, ``resnet.py:181-231``)."""
+
+    res_block: int
+    strides: int
+    features1: int  # bottleneck width
+    features2: int  # output width
+    activation: str | None = "relu"
+    batch_normalization: bool = True
+    spatial: bool = False
+    bn_reduce_axes: tuple[str, ...] = ()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        common = dict(
+            spatial=self.spatial, bn_reduce_axes=self.bn_reduce_axes, dtype=self.dtype
+        )
+        y = ResNetLayer(
+            self.features1,
+            strides=self.strides,
+            activation=self.activation,
+            batch_normalization=self.batch_normalization,
+            conv_first=False,
+            name="r1",
+            **common,
+        )(x)
+        y = ResNetLayer(self.features1, conv_first=False, name="r2", **common)(y)
+        y = ResNetLayer(
+            self.features2, kernel_size=1, conv_first=False, name="r3", **common
+        )(y)
+        if self.res_block == 0:
+            x = ResNetLayer(
+                self.features2,
+                kernel_size=1,
+                strides=self.strides,
+                activation=None,
+                batch_normalization=False,
+                name="r4",
+                **common,
+            )(x)
+        return x + y
+
+
+class HeadV1(nn.Module):
+    """AvgPool(8) + Linear head (ref ``end_part_v1``, ``resnet.py:117-142``;
+    logits instead of softmax — see module docstring)."""
+
+    num_classes: int
+    pool_kernel: int = 8
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = Pool(kind="avg", kernel_size=self.pool_kernel, name="pool")(x)
+        return Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+class HeadV2(nn.Module):
+    """BN + ReLU + AvgPool(8) + Linear head (ref ``end_part_v2``,
+    ``resnet.py:234-267``)."""
+
+    num_classes: int
+    pool_kernel: int = 8
+    bn_reduce_axes: tuple[str, ...] = ()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = TrainBatchNorm(reduce_axes=self.bn_reduce_axes, dtype=self.dtype, name="bn")(x)
+        x = nn.relu(x)
+        x = Pool(kind="avg", kernel_size=self.pool_kernel, name="pool")(x)
+        return Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+def get_resnet_v1(
+    depth: int,
+    num_classes: int = 10,
+    spatial_cells: int = 0,
+    cross_tile_bn: bool = True,
+    dtype: Any = jnp.float32,
+) -> list[nn.Module]:
+    """ResNet v1 as a flat cell list (ref ``get_resnet_v1``, ``resnet.py:145-178``).
+
+    spatial_cells: the first N cells run spatially partitioned (0 = plain
+    model). The head is never spatial (it runs after the tile merge, like the
+    reference's join rank)."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("depth should be 6n+2 (eg 20, 32, 44)")
+    n_blocks = (depth - 2) // 6
+    cells: list[nn.Module] = []
+
+    def sp():
+        return len(cells) < spatial_cells
+
+    cells.append(
+        ResNetLayer(
+            16, spatial=sp(), bn_reduce_axes=_bn_axes(sp(), cross_tile_bn), dtype=dtype
+        )
+    )
+    features = 16
+    for stack in range(3):
+        for res_block in range(n_blocks):
+            strides = 2 if (stack > 0 and res_block == 0) else 1
+            cells.append(
+                CellV1(
+                    stack=stack,
+                    res_block=res_block,
+                    strides=strides,
+                    features=features,
+                    spatial=sp(),
+                    bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+                    dtype=dtype,
+                )
+            )
+        features *= 2
+    cells.append(HeadV1(num_classes=num_classes, dtype=dtype))
+    return cells
+
+
+def get_resnet_v2(
+    depth: int,
+    num_classes: int = 10,
+    spatial_cells: int = 0,
+    cross_tile_bn: bool = True,
+    dtype: Any = jnp.float32,
+) -> list[nn.Module]:
+    """ResNet v2 as a flat cell list (ref ``get_resnet_v2``, ``resnet.py:270-323``)."""
+    if (depth - 2) % 9 != 0:
+        raise ValueError("depth should be 9n+2 (eg 56 or 110)")
+    n_blocks = (depth - 2) // 9
+    cells: list[nn.Module] = []
+
+    def sp():
+        return len(cells) < spatial_cells
+
+    cells.append(
+        ResNetLayer(
+            16,
+            conv_first=True,
+            spatial=sp(),
+            bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+            dtype=dtype,
+        )
+    )
+    features_in = 16  # bottleneck width, constant within a stage
+    for stage in range(3):
+        for res_block in range(n_blocks):
+            strides = 1
+            activation: str | None = "relu"
+            batch_normalization = True
+            if stage == 0:
+                features_out = features_in * 4
+                if res_block == 0:
+                    activation = None
+                    batch_normalization = False
+            else:
+                features_out = features_in * 2
+                if res_block == 0:
+                    strides = 2
+            cells.append(
+                CellV2(
+                    res_block=res_block,
+                    strides=strides,
+                    features1=features_in,
+                    features2=features_out,
+                    activation=activation,
+                    batch_normalization=batch_normalization,
+                    spatial=sp(),
+                    bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+                    dtype=dtype,
+                )
+            )
+        features_in = features_out
+    cells.append(HeadV2(num_classes=num_classes, dtype=dtype))
+    return cells

@@ -1,0 +1,8 @@
+from mpi4dl_tpu.ops.layers import (  # noqa: F401
+    Conv2d,
+    Dense,
+    Pool,
+    TrainBatchNorm,
+    HaloExchange,
+    Sequential,
+)

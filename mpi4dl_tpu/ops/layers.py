@@ -1,0 +1,233 @@
+"""Core layer library (plain + spatially-partitioned variants).
+
+One set of modules covers what the reference implements three times over
+(``src/torchgems/spatial.py`` ``conv_spatial``/``halo_exchange_layer``/``Pool``
+plus the plain torch layers): every module takes a ``spatial`` flag, and when
+set, runs on a local image tile inside ``shard_map`` using
+:func:`mpi4dl_tpu.parallel.halo.halo_exchange` for boundary data.
+
+Layout is NHWC throughout (TPU-native; the reference is NCHW).
+
+Semantics parity notes:
+
+- ``Conv2d(spatial=True)`` == ref ``conv_spatial`` (``spatial.py:25-1029``):
+  zero-pad via neighbor halos then VALID conv; stride-2 requires
+  power-of-two tiles, matching ref's asserts (``train_spatial.py:25-58``).
+- ``TrainBatchNorm`` normalizes with current-batch statistics (training
+  mode). With ``reduce_axes=()`` statistics are tile-local — exactly the
+  reference's per-tile BN behavior under SP. With mesh axis names, stats are
+  ``pmean``-ed across tiles (cross-tile BN) which restores bit-parity with a
+  single-device golden model; this is what the spatial model builders use by
+  default. Running-average stats for eval are intentionally not tracked yet
+  (the reference never reads them either — no eval / checkpoint path).
+- ``Pool(spatial=True)`` == ref ``Pool`` (``spatial.py:1416-1509``): halo
+  exchange of ``padding`` rows/cols, then VALID pooling.
+- ``HaloExchange`` == ref ``halo_exchange_layer`` (``spatial.py:1032-1413``),
+  the building block of the D2 fused-halo design.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4dl_tpu.config import AXIS_TILE_H, AXIS_TILE_W
+from mpi4dl_tpu.parallel.halo import halo_exchange
+
+TILE_AXES = (AXIS_TILE_H, AXIS_TILE_W)
+
+
+def _pair(v) -> tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class TrainBatchNorm(nn.Module):
+    """Batch normalization using current-batch statistics.
+
+    reduce_axes: mesh axis names to average statistics over (cross-tile BN
+    under spatial partitioning). Empty → local statistics (torch
+    ``BatchNorm2d`` training-mode parity per device/tile).
+    """
+
+    eps: float = 1e-5
+    reduce_axes: tuple[str, ...] = ()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
+        red = tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, red)
+        mean_sq = jnp.mean(jnp.square(xf), red)
+        if self.reduce_axes:
+            mean = lax.pmean(mean, self.reduce_axes)
+            mean_sq = lax.pmean(mean_sq, self.reduce_axes)
+        var = mean_sq - jnp.square(mean)
+        y = (xf - mean) * lax.rsqrt(var + self.eps) * scale + bias
+        return y.astype(x.dtype)
+
+
+class Conv2d(nn.Module):
+    """2-D convolution, optionally spatially partitioned.
+
+    Plain mode: symmetric zero padding ``padding`` (default (k-1)//2, torch
+    style), stride ``strides``.
+
+    Spatial mode (ref ``conv_spatial.forward`` ``spatial.py:1019-1029``):
+    halo-exchange ``padding`` rows/cols from neighbor tiles, VALID conv on the
+    extended tile, trim to ``H_local/stride`` outputs (exact equivalence with
+    the global padded conv when tile sizes divide by the stride — the
+    power-of-two constraint the reference asserts).
+
+    ``exchange=False`` (with ``spatial=True``) gives the D2 "shrink" conv: no
+    exchange, VALID conv on an input that already carries a wide halo — the
+    output halo shrinks by (k-1)/2 (ref ``resnet_spatial_d2.py``).
+    """
+
+    features: int
+    kernel_size: Any = 3
+    strides: Any = 1
+    padding: Any = None  # int/pair; None → (k-1)//2
+    use_bias: bool = True
+    spatial: bool = False
+    exchange: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.strides)
+        if self.padding is None:
+            ph, pw = (kh - 1) // 2, (kw - 1) // 2
+        else:
+            ph, pw = _pair(self.padding)
+
+        if not self.spatial:
+            return nn.Conv(
+                features=self.features,
+                kernel_size=(kh, kw),
+                strides=(sh, sw),
+                padding=((ph, ph), (pw, pw)),
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
+
+        if self.exchange:
+            h_loc, w_loc = x.shape[1], x.shape[2]
+            x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+            y = nn.Conv(
+                features=self.features,
+                kernel_size=(kh, kw),
+                strides=(sh, sw),
+                padding="VALID",
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
+            # Trim to this tile's share of the global output grid. The first
+            # VALID output aligns with the global grid because tile sizes are
+            # multiples of the stride (power-of-two asserts, config.validate).
+            return y[:, : h_loc // sh, : w_loc // sw, :]
+
+        # D2 shrink conv: input already carries a wide halo; VALID conv eats
+        # (k-1) of it per dim. Strided shrink convs are handled by the D2
+        # builder's halo-size formulas.
+        return nn.Conv(
+            features=self.features,
+            kernel_size=(kh, kw),
+            strides=(sh, sw),
+            padding="VALID",
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+
+
+class Pool(nn.Module):
+    """Max/avg pooling, optionally with halo exchange (ref ``Pool``,
+    ``spatial.py:1416-1509``).
+
+    The reference asserts halo_len == padding and square kernels
+    (``spatial.py:1445-1464``); we support rectangular but keep the same
+    halo == padding rule.
+    """
+
+    kind: str  # "max" | "avg"
+    kernel_size: Any = 2
+    strides: Any = None  # None → kernel_size (torch default)
+    padding: Any = 0
+    spatial: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.strides if self.strides is not None else (kh, kw))
+        ph, pw = _pair(self.padding)
+        h_loc, w_loc = x.shape[1], x.shape[2]
+
+        if self.spatial and (ph or pw):
+            x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+            pad = ((0, 0), (0, 0))
+        else:
+            pad = ((ph, ph), (pw, pw))
+
+        if self.kind == "max":
+            y = nn.max_pool(x, (kh, kw), strides=(sh, sw), padding=pad)
+        elif self.kind == "avg":
+            # count_include_pad=True parity: plain mean over the window,
+            # zeros included (torch AvgPool2d default).
+            y = nn.avg_pool(x, (kh, kw), strides=(sh, sw), padding=pad, count_include_pad=True)
+        else:
+            raise ValueError(f"unknown pool kind {self.kind!r}")
+
+        if self.spatial and (ph or pw):
+            y = y[:, : h_loc // sh, : w_loc // sw, :]
+        return y
+
+
+class HaloExchange(nn.Module):
+    """Standalone halo-exchange layer (ref ``halo_exchange_layer``,
+    ``spatial.py:1032-1413``): pad the tile with ``halo_len`` rows/cols of
+    neighbor data and return it. Used by the D2 fused-halo design to amortize
+    one wide exchange over several shrink convs."""
+
+    halo_len: Any = 1
+
+    @nn.compact
+    def __call__(self, x):
+        ph, pw = _pair(self.halo_len)
+        return halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+
+
+class Dense(nn.Module):
+    features: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.features, dtype=self.dtype, name="fc")(x)
+
+
+class Sequential(nn.Module):
+    """Flat layer sequence — the unit the stage partitioner slices
+    (ref builds flat ``nn.Sequential(OrderedDict)`` for the same reason,
+    ``resnet.py:149-178``). Values between layers may be pytrees (AmoebaNet
+    cells pass ``(concat, skip)`` tuples)."""
+
+    layers: Sequence[Callable]
+
+    @nn.compact
+    def __call__(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x

@@ -1,0 +1,1 @@
+from mpi4dl_tpu.parallel.halo import halo_exchange  # noqa: F401

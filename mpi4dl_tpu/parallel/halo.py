@@ -1,0 +1,69 @@
+"""Halo exchange over the tile mesh axes.
+
+TPU-native replacement for the reference's 9-neighbor isend/irecv machinery
+(``src/torchgems/spatial.py:336-413``, neighbor model ``spatial.py:941-1017``).
+
+The reference enumerates up to 8 neighbors (including corners) and posts
+tagged MPI isend/irecv pairs per conv layer. On TPU the whole exchange is two
+``lax.ppermute`` shift rounds inside ``shard_map``:
+
+1. shift edge strips along ``tile_h`` (up and down);
+2. shift edge strips (of the H-extended tile) along ``tile_w`` (left/right).
+
+Round 2 operates on the output of round 1, so corner halos arrive via the
+two-hop composition — no explicit diagonal neighbors needed. Devices at the
+mesh boundary receive zeros from ``ppermute`` (sources absent from the
+permutation), which reproduces the reference's ``ZeroPad2d`` edge semantics
+(``spatial.py:130-144``) exactly.
+
+Everything here runs *inside* ``shard_map`` on a local tile of layout
+``[batch, H_local, W_local, C]`` (NHWC — the TPU-friendly layout; the
+reference is NCHW).
+"""
+
+from __future__ import annotations
+
+from jax import lax
+import jax.numpy as jnp
+
+
+def _shift(x, axis_name: str, direction: int):
+    """ppermute x one step along a mesh axis; missing sources yield zeros."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, i + direction) for i in range(n) if 0 <= i + direction < n]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def halo_exchange(
+    x,
+    halo_h: int,
+    halo_w: int,
+    axis_h: str = "tile_h",
+    axis_w: str = "tile_w",
+):
+    """Return the local tile padded with ``halo_h``/``halo_w`` rows/cols of
+    neighbor data (zeros at the global image boundary).
+
+    x: [B, H, W, C] local tile (inside shard_map).
+    Result: [B, H + 2*halo_h, W + 2*halo_w, C].
+
+    Equivalent of ref ``start_halo_exchange`` + ``end_halo_exchange`` +
+    ``copy_halo_exchange_values`` (``spatial.py:336-413``) fused into pure
+    dataflow — no tags, no waits, no ``cuda.synchronize``.
+    """
+    b, h, w, c = x.shape
+    if halo_h > 0:
+        if halo_h > h:
+            raise ValueError(f"halo_h={halo_h} exceeds local tile height {h}")
+        # Neighbor above sends its bottom strip down (+1); neighbor below
+        # sends its top strip up (-1).
+        from_above = _shift(x[:, h - halo_h :, :, :], axis_h, +1)
+        from_below = _shift(x[:, :halo_h, :, :], axis_h, -1)
+        x = jnp.concatenate([from_above, x, from_below], axis=1)
+    if halo_w > 0:
+        if halo_w > w:
+            raise ValueError(f"halo_w={halo_w} exceeds local tile width {w}")
+        from_left = _shift(x[:, :, w - halo_w :, :], axis_w, +1)
+        from_right = _shift(x[:, :, :halo_w, :], axis_w, -1)
+        x = jnp.concatenate([from_left, x, from_right], axis=2)
+    return x

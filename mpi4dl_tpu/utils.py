@@ -1,0 +1,18 @@
+"""Small helpers (parity with reference ``src/torchgems/utils.py``)."""
+
+
+def is_power_two(n: int) -> bool:
+    """True iff n is a positive power of two (ref ``utils.py:20-21``)."""
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def get_depth(version: int, n: int) -> int:
+    """ResNet depth from block multiplier n (ref ``utils.py:26-30``).
+
+    v1: depth = 6n + 2, v2 (bottleneck): depth = 9n + 2.
+    """
+    if version == 1:
+        return n * 6 + 2
+    elif version == 2:
+        return n * 9 + 2
+    raise ValueError(f"unknown resnet version {version}")

@@ -1,0 +1,16 @@
+"""Test harness: simulate an 8-device TPU-like mesh on CPU.
+
+The reference has no tests (SURVEY.md §4) — correctness there requires ≥4
+real GPUs + MPI. Here every distributed schedule runs single-process on 8
+virtual CPU devices, so halo/pipeline/GEMS can be validated bit-for-bit
+against single-device golden models in CI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
