@@ -116,6 +116,14 @@ class ParallelConfig:
                 raise ValueError(
                     "num_spatial_parts must have one entry or spatial_size entries"
                 )
+            if len(set(self.num_spatial_parts)) != 1:
+                # Reference parity: "Size of each SP partition should be same"
+                # (train_spatial.py:55-58). Skewed multi-stage SP (4->2 parts)
+                # is a later milestone; until then reject rather than mis-shard.
+                raise ValueError(
+                    "all spatial part counts must be equal "
+                    f"(got {self.num_spatial_parts})"
+                )
             for p in self.num_spatial_parts:
                 if not is_power_two(p):
                     raise ValueError("each spatial part count must be a power of two")

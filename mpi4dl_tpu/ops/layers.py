@@ -46,6 +46,21 @@ def _pair(v) -> tuple[int, int]:
     return (int(v), int(v))
 
 
+def _check_window_coverage(kh, kw, sh, sw, ph, pw):
+    """A spatially-partitioned windowed op is only exact when the halo
+    (== padding) covers the window overlap beyond the stride: windows that
+    straddle a tile boundary need ``k - s`` rows/cols of neighbor data and the
+    exchange provides ``2*p``. The reference enforces the pool flavor of this
+    with asserts (``spatial.py:1445-1464``); without the check the stitched
+    output silently drops cross-boundary windows."""
+    if kh - sh > 2 * ph or kw - sw > 2 * pw:
+        raise ValueError(
+            f"spatial window op needs padding >= (kernel - stride)/2 per dim "
+            f"to cover tile-boundary windows; got kernel=({kh},{kw}) "
+            f"strides=({sh},{sw}) padding=({ph},{pw})"
+        )
+
+
 class TrainBatchNorm(nn.Module):
     """Batch normalization using current-batch statistics.
 
@@ -122,6 +137,7 @@ class Conv2d(nn.Module):
             )(x)
 
         if self.exchange:
+            _check_window_coverage(kh, kw, sh, sw, ph, pw)
             h_loc, w_loc = x.shape[1], x.shape[2]
             x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
             y = nn.Conv(
@@ -174,8 +190,13 @@ class Pool(nn.Module):
         ph, pw = _pair(self.padding)
         h_loc, w_loc = x.shape[1], x.shape[2]
 
+        if self.spatial:
+            # Applies to the padding==0 case too (e.g. kernel 3 stride 2
+            # padding 0 would silently drop cross-boundary windows).
+            _check_window_coverage(kh, kw, sh, sw, ph, pw)
         if self.spatial and (ph or pw):
-            x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+            fill = float("-inf") if self.kind == "max" else 0.0
+            x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W, fill_value=fill)
             pad = ((0, 0), (0, 0))
         else:
             pad = ((ph, ph), (pw, pw))

@@ -40,9 +40,10 @@ def halo_exchange(
     halo_w: int,
     axis_h: str = "tile_h",
     axis_w: str = "tile_w",
+    fill_value: float = 0.0,
 ):
     """Return the local tile padded with ``halo_h``/``halo_w`` rows/cols of
-    neighbor data (zeros at the global image boundary).
+    neighbor data (``fill_value`` at the global image boundary).
 
     x: [B, H, W, C] local tile (inside shard_map).
     Result: [B, H + 2*halo_h, W + 2*halo_w, C].
@@ -50,8 +51,26 @@ def halo_exchange(
     Equivalent of ref ``start_halo_exchange`` + ``end_halo_exchange`` +
     ``copy_halo_exchange_values`` (``spatial.py:336-413``) fused into pure
     dataflow — no tags, no waits, no ``cuda.synchronize``.
+
+    ``fill_value=0`` reproduces conv ``ZeroPad2d`` semantics
+    (``spatial.py:130-144``); max pooling passes ``-inf`` so the distributed
+    pool matches single-device max pooling exactly (the reference zero-pads
+    its distributed max pool, silently diverging from torch's -inf-padded
+    ``MaxPool2d`` for negative boundary activations — we fix that).
     """
     b, h, w, c = x.shape
+
+    def _edge_fill(strip, axis_name, at_index):
+        """Overwrite a received strip with fill_value on boundary devices
+        (ppermute already delivered zeros there; rewrite if fill != 0)."""
+        if fill_value == 0.0:
+            return strip
+        return jnp.where(
+            lax.axis_index(axis_name) == at_index,
+            jnp.full_like(strip, fill_value),
+            strip,
+        )
+
     if halo_h > 0:
         if halo_h > h:
             raise ValueError(f"halo_h={halo_h} exceeds local tile height {h}")
@@ -59,11 +78,15 @@ def halo_exchange(
         # sends its top strip up (-1).
         from_above = _shift(x[:, h - halo_h :, :, :], axis_h, +1)
         from_below = _shift(x[:, :halo_h, :, :], axis_h, -1)
+        from_above = _edge_fill(from_above, axis_h, 0)
+        from_below = _edge_fill(from_below, axis_h, lax.axis_size(axis_h) - 1)
         x = jnp.concatenate([from_above, x, from_below], axis=1)
     if halo_w > 0:
         if halo_w > w:
             raise ValueError(f"halo_w={halo_w} exceeds local tile width {w}")
         from_left = _shift(x[:, :, w - halo_w :, :], axis_w, +1)
         from_right = _shift(x[:, :, :halo_w, :], axis_w, -1)
+        from_left = _edge_fill(from_left, axis_w, 0)
+        from_right = _edge_fill(from_right, axis_w, lax.axis_size(axis_w) - 1)
         x = jnp.concatenate([from_left, x, from_right], axis=2)
     return x

@@ -4,13 +4,14 @@ The reference has no tests (SURVEY.md §4) — correctness there requires ≥4
 real GPUs + MPI. Here every distributed schedule runs single-process on 8
 virtual CPU devices, so halo/pipeline/GEMS can be validated bit-for-bit
 against single-device golden models in CI.
+
+Note: the axon TPU plugin (when present) force-sets ``jax_platforms`` via
+``jax.config`` during site initialization, which overrides the
+``JAX_PLATFORMS`` env var — so we must override back through ``jax.config``,
+not the environment.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
