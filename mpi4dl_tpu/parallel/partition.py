@@ -1,0 +1,128 @@
+"""Stage partitioning + shape tracing.
+
+TPU-native replacement for the reference's ``model_generator``
+(``src/torchgems/mp_pipeline.py:28-168``). The reference slices a flat
+``nn.Sequential`` into ``split_size`` contiguous stages (even split or a user
+``balance`` list) and discovers per-stage output shapes by *dry-running* each
+stage on a batch-1 zeros tensor on GPU (``get_output_shapes``
+``mp_pipeline.py:126-168``). Here models are flat **cell lists** and shape
+tracing is ``jax.eval_shape`` — exact, free, and no device round-trip, so no
+two-phase "trace small then rescale" dance (``benchmark_resnet_lp.py:92-161``)
+is needed; we trace at the real size directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+
+
+def stage_bounds(
+    num_layers: int, split_size: int, balance: Sequence[int] | None = None
+) -> list[tuple[int, int]]:
+    """Per-stage ``(start, end)`` cell indices.
+
+    Parity with ``get_start_end_layer_index`` (``mp_pipeline.py:41-69``):
+    even split is ``floor(n/split)`` per stage with the remainder folded into
+    the last stage; ``balance`` gives explicit per-stage counts and must sum
+    to the layer count.
+    """
+    if split_size < 1:
+        raise ValueError("split_size must be >= 1")
+    if balance is not None:
+        if len(balance) != split_size:
+            raise ValueError("balance list length must equal split_size")
+        if sum(balance) != num_layers:
+            raise ValueError(
+                f"balance {tuple(balance)} sums to {sum(balance)}, "
+                f"model has {num_layers} layers"
+            )
+        bounds, start = [], 0
+        for b in balance:
+            bounds.append((start, start + b))
+            start += b
+        return bounds
+    if split_size > num_layers:
+        raise ValueError(f"cannot split {num_layers} layers into {split_size} stages")
+    per = num_layers // split_size
+    bounds = [(i * per, (i + 1) * per) for i in range(split_size)]
+    bounds[-1] = (bounds[-1][0], num_layers)
+    return bounds
+
+
+def split_cells(
+    cells: Sequence[Any], split_size: int, balance: Sequence[int] | None = None
+) -> list[list[Any]]:
+    """Slice a flat cell list into per-stage cell lists (ref ``get_model``,
+    ``mp_pipeline.py:71-83``)."""
+    return [
+        list(cells[s:e]) for s, e in stage_bounds(len(cells), split_size, balance)
+    ]
+
+
+def _apply_stage(stage_cells, variables_list, x):
+    for cell, variables in zip(stage_cells, variables_list):
+        x = cell.apply(variables, x)
+    return x
+
+
+def init_cells(cells: Sequence[Any], rng, x) -> list[Any]:
+    """Initialize a flat cell list sequentially, threading activations.
+
+    Returns one variables dict per cell. Must be called on the *plain*
+    (non-spatial) twin of a model — spatial cells contain collectives that
+    need mesh axis bindings; plain twins have identical parameter structure
+    (same submodule names), so the resulting params drop into the spatial
+    model unchanged.
+    """
+    rngs = jax.random.split(rng, len(cells))
+    out = []
+    for cell, r in zip(cells, rngs):
+        variables = cell.init(r, x)
+        x = cell.apply(variables, x)
+        out.append(variables)
+    return out
+
+
+def trace_shapes(
+    cells: Sequence[Any],
+    split_size: int,
+    input_shape: Sequence[int],
+    balance: Sequence[int] | None = None,
+    dtype=None,
+) -> list[Any]:
+    """Per-stage output shapes (ref ``get_output_shapes``
+    ``mp_pipeline.py:126-168``) via ``jax.eval_shape`` on the plain model.
+
+    Returns one entry per stage: a shape tuple, or a pytree of shape tuples
+    for multi-output stages (AmoebaNet cells return ``(concat, skip)``; the
+    reference calls this ``MULTIPLE_INPUT/OUTPUT``).
+    """
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.float32
+    stages = split_cells(cells, split_size, balance)
+    x = jax.ShapeDtypeStruct(tuple(input_shape), dtype)
+    rng = jax.random.PRNGKey(0)
+    shapes: list[Any] = []
+
+    for stage_cells in stages:
+
+        def run(xx, stage_cells=stage_cells):
+            vs = init_cells(stage_cells, rng, xx)
+            return _apply_stage(stage_cells, vs, xx)
+
+        x = jax.eval_shape(run, x)
+        shapes.append(jax.tree.map(lambda s: tuple(s.shape), x, is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct)))
+    return shapes
+
+
+def spatial_shape(shape: Sequence[int], tile_shape: tuple[int, int]) -> tuple[int, ...]:
+    """Per-tile shape of a spatially partitioned NHWC activation (ref
+    ``get_shapes_spatial`` rescaling, ``train_spatial.py:61-238``)."""
+    b, h, w, c = shape
+    th, tw = tile_shape
+    if h % th or w % tw:
+        raise ValueError(f"activation {shape} not divisible by tile grid {tile_shape}")
+    return (b, h // th, w // tw, c)

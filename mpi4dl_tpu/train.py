@@ -1,0 +1,217 @@
+"""Training engine: loss, optimizer, and the single-jit spatial(+DP) trainer.
+
+This is the TPU-native counterpart of the reference's training orchestration
+(``src/torchgems/train_spatial.py`` + the ``SyncAllreduce`` gradient engine,
+``src/torchgems/comm.py:335-522``). The reference coordinates dozens of MPI
+ranks with tagged isend/irecv and hand-rolled flat-gradient allreduces; here
+one jitted SPMD program runs over a ``jax.sharding.Mesh`` and XLA inserts the
+collectives:
+
+- input ``split_input`` (``train_spatial.py:241-290``) → ``shard_map``
+  in_specs sharding the batch over ``data`` and H/W over ``tile_h``/``tile_w``;
+- join-rank tile merge (``train_spatial.py:1083-1188``) → tiled
+  ``all_gather`` (:func:`mpi4dl_tpu.parallel.halo.gather_tiles`);
+- ``SyncAllreduce`` flat-grad allreduce + ``divide_bs`` mean semantics
+  (``comm.py:414-514``) → nothing: gradients come out of ``jax.grad``
+  already globally correct because the loss is written as a *sum of
+  per-device contributions* psum-ed over every mesh axis (see
+  ``_local_loss``); XLA fuses the resulting reduction with the backward pass.
+
+Optimizer parity: SGD lr=0.001 momentum=0.9 (``mp_pipeline.py:230-234``),
+loss = cross entropy (``mp_pipeline.py:225-228``; we feed logits, not the
+reference's double softmax — see ``models/resnet.py`` docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi4dl_tpu.config import (
+    AXIS_DATA,
+    AXIS_PIPE,
+    AXIS_TILE_H,
+    AXIS_TILE_W,
+    ParallelConfig,
+)
+from mpi4dl_tpu.parallel.halo import gather_tiles
+
+
+def make_optimizer(learning_rate: float = 0.001, momentum: float = 0.9):
+    """Reference default optimizer (``mp_pipeline.py:230-234``)."""
+    return optax.sgd(learning_rate, momentum=momentum)
+
+
+def cross_entropy_sum(logits, labels) -> jax.Array:
+    """Sum (not mean) of per-example CE — callers normalize explicitly so the
+    psum-of-contributions bookkeeping stays exact under sharding."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    )
+    return jnp.sum(ce)
+
+
+def correct_count(logits, labels) -> jax.Array:
+    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def apply_cells(cells: Sequence[Any], params: Sequence[Any], x):
+    for cell, p in zip(cells, params):
+        x = cell.apply(p, x)
+    return x
+
+
+class Trainer:
+    """Single-program trainer for plain / DP / SP / SP+DP configs
+    (``split_size == 1`` — no pipeline; the pipeline engine composes the same
+    pieces over the ``pipe`` axis).
+
+    cells: flat cell list (spatial flags baked in by the model builder).
+    plain_cells: non-spatial twin with identical param structure, used for
+        initialization and available to tests as the golden model. Required
+        when ``num_spatial_cells > 0``.
+    """
+
+    def __init__(
+        self,
+        cells: Sequence[Any],
+        num_spatial_cells: int,
+        config: ParallelConfig,
+        plain_cells: Sequence[Any] | None = None,
+        mesh=None,
+        learning_rate: float = 0.001,
+        momentum: float = 0.9,
+    ):
+        if num_spatial_cells > 0 and plain_cells is None:
+            raise ValueError("spatial models need plain_cells for initialization")
+        self.cells = list(cells)
+        self.plain_cells = list(plain_cells) if plain_cells is not None else self.cells
+        self.n_spatial = num_spatial_cells
+        self.config = config
+        self.mesh = mesh if mesh is not None else config.make_mesh()
+        self.tx = make_optimizer(learning_rate, momentum)
+        if self.n_spatial > 0:
+            self.x_spec = P(AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W, None)
+        else:
+            # No spatial section → the input is only batch-sharded; any tile
+            # axes in the mesh run the whole model redundantly (still correct
+            # via the psum-of-contributions normalization).
+            self.x_spec = P(AXIS_DATA, None, None, None)
+        self.y_spec = P(AXIS_DATA)
+        self._jit_step = jax.jit(self._train_step, donate_argnums=0)
+
+    # -- initialization ------------------------------------------------------
+    def init(self, rng, sample_shape: Sequence[int], dtype=jnp.float32) -> TrainState:
+        """Init on the plain twin (spatial cells can't trace outside a mesh
+        context; param structure is identical — ``partition.init_cells``)."""
+        from mpi4dl_tpu.parallel.partition import init_cells
+
+        x = jnp.zeros(tuple(sample_shape), dtype)
+        params = init_cells(self.plain_cells, rng, x)
+        return TrainState(
+            params=params,
+            opt_state=self.tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    # -- loss ----------------------------------------------------------------
+    def _local_loss(self, params, x, y):
+        """Per-device loss contribution; runs inside shard_map.
+
+        Contributions are scaled so that ``psum`` over every mesh axis equals
+        the global batch mean — forward value and gradients are then exact
+        regardless of how many devices redundantly compute the post-join
+        (replicated) section. This one line replaces the reference's
+        ``divide_bs`` case analysis (``comm.py:349-358``).
+        """
+        h = x
+        for i, cell in enumerate(self.cells):
+            if i == self.n_spatial and self.n_spatial > 0:
+                h = gather_tiles(h)
+            h = cell.apply(params[i], h)
+        logits = h
+
+        d = lax.axis_size(AXIS_DATA)
+        replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
+        global_b = y.shape[0] * d
+        denom = global_b * replicas
+        axes = (AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W)
+        loss = lax.psum(cross_entropy_sum(logits, y) / denom, axes)
+        acc = lax.psum(correct_count(logits, y).astype(jnp.float32) / denom, axes)
+        return loss, acc
+
+    def _sharded_loss(self, params, x, y):
+        fn = shard_map(
+            self._local_loss,
+            mesh=self.mesh,
+            in_specs=(P(), self.x_spec, self.y_spec),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return fn(params, x, y)
+
+    # -- step ----------------------------------------------------------------
+    def _train_step(self, state: TrainState, x, y):
+        def loss_fn(params):
+            return self._sharded_loss(params, x, y)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1
+        )
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    def shard_batch(self, x, y):
+        """Place a host batch onto the mesh with the trainer's sharding
+        (the ``split_input`` moment, minus the hand-slicing)."""
+        xs = jax.device_put(x, NamedSharding(self.mesh, self.x_spec))
+        ys = jax.device_put(y, NamedSharding(self.mesh, self.y_spec))
+        return xs, ys
+
+    def train_step(self, state: TrainState, x, y):
+        return self._jit_step(state, x, y)
+
+
+def single_device_step(cells: Sequence[Any], learning_rate=0.001, momentum=0.9):
+    """Golden single-device train step (tests compare distributed runs
+    against this — the role the reference's sequential-conv golden runs play
+    in ``benchmark_sp_halo_exchange_with_compute_val.py:704-780``)."""
+    tx = make_optimizer(learning_rate, momentum)
+
+    @jax.jit
+    def step(state: TrainState, x, y):
+        def loss_fn(params):
+            logits = apply_cells(cells, params, x)
+            b = y.shape[0]
+            return (
+                cross_entropy_sum(logits, y) / b,
+                correct_count(logits, y).astype(jnp.float32) / b,
+            )
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            {"loss": loss, "accuracy": acc},
+        )
+
+    return tx, step

@@ -1,0 +1,53 @@
+"""Stage partitioner + shape tracing tests (ref ``model_generator``
+semantics, ``mp_pipeline.py:41-168``)."""
+
+import jax.numpy as jnp
+import pytest
+
+from mpi4dl_tpu.models.resnet import get_resnet_v1
+from mpi4dl_tpu.parallel.partition import (
+    spatial_shape,
+    split_cells,
+    stage_bounds,
+    trace_shapes,
+)
+
+
+def test_even_split_remainder_to_last_stage():
+    # floor(10/3)=3 per stage, remainder folds into the last
+    # (mp_pipeline.py:46-53).
+    assert stage_bounds(10, 3) == [(0, 3), (3, 6), (6, 10)]
+    assert stage_bounds(8, 2) == [(0, 4), (4, 8)]
+    assert stage_bounds(5, 1) == [(0, 5)]
+
+
+def test_balance_split():
+    assert stage_bounds(10, 3, balance=[5, 3, 2]) == [(0, 5), (5, 8), (8, 10)]
+    with pytest.raises(ValueError, match="sums to"):
+        stage_bounds(10, 3, balance=[5, 3, 3])
+    with pytest.raises(ValueError, match="length"):
+        stage_bounds(10, 3, balance=[5, 5])
+
+
+def test_split_cells_partition_is_exact():
+    cells = list(range(11))
+    stages = split_cells(cells, 4)
+    assert [len(s) for s in stages] == [2, 2, 2, 5]
+    assert sum(stages, []) == cells
+
+
+def test_trace_shapes_resnet():
+    cells = get_resnet_v1(depth=8, num_classes=10)
+    shapes = trace_shapes(cells, split_size=2, input_shape=(4, 32, 32, 3))
+    assert len(shapes) == 2
+    # last stage output: logits
+    assert shapes[-1] == (4, 10)
+    # first stage output: NHWC activation
+    assert len(shapes[0]) == 4 and shapes[0][0] == 4
+
+
+def test_spatial_shape():
+    assert spatial_shape((2, 32, 32, 3), (2, 2)) == (2, 16, 16, 3)
+    assert spatial_shape((2, 32, 32, 3), (1, 4)) == (2, 32, 8, 3)
+    with pytest.raises(ValueError):
+        spatial_shape((2, 30, 32, 3), (4, 1))
