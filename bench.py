@@ -1,0 +1,86 @@
+"""Headline benchmark: ResNet-110(v2) training throughput at 1024x1024.
+
+Reference baseline (BASELINE.md): best published MPI4DL number for ResNet at
+1024px is ~3.1 images/sec (batch 2, spatial parallelism, square slicing +
+halo-D2, multi-GPU MVAPICH2-GDR cluster; read off
+``docs/assets/images/ResNet_img_size_1024.png``). This script trains the same
+depth-110 v2 model at 1024px on however many devices are available (one real
+TPU chip under the driver) and prints one JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 3.1  # ResNet 1024px bs=2, best SP config (BASELINE.md)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.train import Trainer
+    from mpi4dl_tpu.utils import get_depth
+
+    platform = jax.devices()[0].platform
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = 2
+    if platform == "cpu" and "BENCH_IMAGE_SIZE" not in os.environ:
+        image_size, steps = 128, 3  # keep the CPU smoke path tractable
+
+    depth = get_depth(2, 12)  # 110 — the reference benchmark's ResNet
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    cells = get_resnet_v2(
+        depth=depth, num_classes=10, pool_kernel=image_size // 4, dtype=dtype
+    )
+
+    cfg = ParallelConfig(
+        batch_size=batch, split_size=1, spatial_size=0, image_size=image_size
+    )
+    # Per-cell rematerialization: ResNet-110 @1024px stores ~64G of
+    # activations without it — far beyond one chip's HBM.
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat=True)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((batch, image_size, image_size, 3)), dtype
+    )
+    y = jnp.asarray(rng.integers(0, 10, size=(batch,)), jnp.int32)
+    xs, ys = trainer.shard_batch(x, y)
+    state = trainer.init(jax.random.PRNGKey(0), x.shape, dtype=dtype)
+
+    for _ in range(warmup):
+        state, metrics = trainer.train_step(state, xs, ys)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, xs, ys)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"resnet110_{image_size}px_bs{batch}_train_{platform}",
+                "value": round(images_per_sec, 3),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

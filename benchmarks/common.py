@@ -1,0 +1,194 @@
+"""Shared benchmark runner.
+
+Replaces the per-script boilerplate of the reference's 8 training benchmarks
+(``benchmarks/*/benchmark_*.py``): parse the shared CLI, build the
+``ParallelConfig`` + trainer for the requested parallelism mode, run epochs
+with per-step timing, print images/sec mean/median at exit
+(ref timing: ``benchmark_amoebanet_sp.py:322-367`` — CUDA events there,
+host-side timing with ``block_until_ready`` here; both wall-clock).
+
+All benchmarks run single-process SPMD over however many devices JAX sees:
+real TPUs, or CPU simulation via
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
+(no ``mpirun_rsh``; the launcher contract collapses into JAX device
+discovery).
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def parse_csv_ints(s):
+    if s is None:
+        return None
+    return [int(v) for v in str(s).split(",")]
+
+
+def build_config(args, spatial: bool, num_cells: int | None = None):
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.config import ParallelConfig
+
+    return ParallelConfig(
+        batch_size=args.batch_size,
+        parts=args.parts,
+        split_size=args.split_size,
+        num_spatial_parts=tuple(parse_csv_ints(args.num_spatial_parts) or (4,)),
+        spatial_size=args.spatial_size if spatial else 0,
+        slice_method=args.slice_method,
+        times=args.times,
+        image_size=args.image_size,
+        num_classes=args.num_classes,
+        balance=parse_csv_ints(args.balance),
+        halo_d2=args.halo_d2,
+        fused_layers=args.fused_layers,
+        local_dp=args.local_DP,
+        precision=args.precision,
+    )
+
+
+def build_resnet(args, cfg, spatial_cells=0):
+    """Returns (cells, plain_twin[, n_spatial_override]).
+
+    --halo-D2 swaps the spatial region for the fused-halo design (one wide
+    exchange per ``--fused-layers`` bottleneck cells)."""
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.models.resnet import get_resnet_v2, get_resnet_v2_d2
+    from mpi4dl_tpu.utils import get_depth
+
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    depth = get_depth(2, 12)  # the reference resnet benchmarks' ResNet-110
+    kw = dict(
+        depth=depth,
+        num_classes=args.num_classes,
+        # Final feature map is image/4; pool it fully (1x1 output).
+        pool_kernel=max(args.image_size // 4, 1),
+    )
+    if args.halo_d2 and spatial_cells:
+        cells, plain, n_sp = get_resnet_v2_d2(
+            spatial_cells=spatial_cells,
+            fused_layers=args.fused_layers,
+            dtype=dtype,
+            **kw,
+        )
+        return cells, plain, n_sp
+    return (
+        get_resnet_v2(spatial_cells=spatial_cells, dtype=dtype, **kw),
+        get_resnet_v2(dtype=jnp.float32, **kw),
+    )
+
+
+def build_amoebanet(args, cfg, spatial_cells=0):
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    kw = dict(
+        num_classes=args.num_classes,
+        num_layers=args.num_layers,
+        num_filters=args.num_filters,
+    )
+    return (
+        amoebanetd(spatial_cells=spatial_cells, dtype=dtype, **kw),
+        amoebanetd(dtype=jnp.float32, **kw),
+    )
+
+
+def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=None):
+    import jax
+
+    from mpi4dl_tpu.parallel.pipeline import GemsMasterTrainer, PipelineTrainer
+    from mpi4dl_tpu.train import Trainer
+
+    n_dev = cfg.num_devices
+    if len(jax.devices()) < n_dev:
+        sys.exit(
+            f"config needs {n_dev} devices (mesh {cfg.mesh_shape}); "
+            f"have {len(jax.devices())}. For CPU simulation set "
+            f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count={n_dev}"
+        )
+    override = n_spatial  # None → trainers derive from config stage bounds
+    if n_spatial is None:
+        n_spatial = (
+            PipelineTrainer.spatial_cell_count(len(cells), cfg)
+            if cfg.spatial_size
+            else 0
+        )
+    if gems:
+        return (
+            GemsMasterTrainer(
+                cells, cfg, plain_cells=plain_cells, num_spatial_cells=override
+            ),
+            n_spatial,
+        )
+    if cfg.split_size == 1 or cfg.spatial_size == cfg.split_size:
+        return (
+            Trainer(
+                cells,
+                num_spatial_cells=n_spatial,
+                config=cfg,
+                plain_cells=plain_cells,
+            ),
+            n_spatial,
+        )
+    return (
+        PipelineTrainer(
+            cells, cfg, plain_cells=plain_cells, num_spatial_cells=override
+        ),
+        n_spatial,
+    )
+
+
+def run_training(args, trainer, tag: str):
+    """Epoch loop with per-step wall-clock timing (ref
+    ``benchmark_amoebanet_sp.py:315-367``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.data import get_dataset
+
+    cfg = trainer.config
+    chunks = getattr(trainer, "chunks", 1)
+    global_batch = chunks * cfg.batch_size
+    ds = get_dataset(args, global_batch, cfg.num_classes)
+
+    if hasattr(trainer, "init_params") or not hasattr(trainer, "n_spatial"):
+        state = trainer.init(jax.random.PRNGKey(0))
+    else:
+        state = trainer.init(
+            jax.random.PRNGKey(0),
+            (global_batch, cfg.image_size, cfg.image_size, 3),
+        )
+
+    perf = []
+    for epoch in range(args.num_epochs):
+        for step, (x, y) in enumerate(ds):
+            xs, ys = trainer.shard_batch(jnp.asarray(x), jnp.asarray(y))
+            t0 = time.perf_counter()
+            state, metrics = trainer.train_step(state, xs, ys)
+            loss = float(metrics["loss"])  # blocks
+            dt = time.perf_counter() - t0
+            if step > 0:  # skip compile step, like the reference's warmup
+                perf.append(global_batch / dt)
+            if args.verbose:
+                print(
+                    f"epoch {epoch} step {step}: loss {loss:.4f} "
+                    f"acc {float(metrics['accuracy']):.4f} "
+                    f"({global_batch / dt:.3f} img/s)"
+                )
+            max_steps = getattr(args, "max_steps", None)
+            if max_steps is not None and step + 1 >= max_steps:
+                break
+    if perf:
+        print(
+            f"{tag}: Mean {statistics.mean(perf):.3f} img/s "
+            f"Median {statistics.median(perf):.3f} img/s"
+        )
+    return state

@@ -9,14 +9,18 @@ This package re-designs those capabilities TPU-first:
 
 - one ``jax.sharding.Mesh`` with axes ``("data", "pipe", "tile_h", "tile_w")``
   replaces the reference's MPI process groups (``src/torchgems/comm.py``);
-- the LP/PP send/recv pipeline (``src/torchgems/mp_pipeline.py``) becomes a
-  collective-permute GPipe schedule inside one jitted SPMD program
-  (:mod:`mpi4dl_tpu.parallel.pipeline`);
 - halo-exchange spatial convolution (``src/torchgems/spatial.py``) becomes
-  ``shard_map`` + ``lax.ppermute`` neighbor shifts (:mod:`mpi4dl_tpu.ops.spatial`);
-- GEMS-MASTER (``src/torchgems/gems_master.py``) becomes a mirrored dual
-  pipeline in the same program (:mod:`mpi4dl_tpu.parallel.gems`);
-- gradient sync (``SyncAllreduce``) becomes ``psum`` over mesh axes.
+  ``shard_map`` + ``lax.ppermute`` neighbor shifts
+  (:mod:`mpi4dl_tpu.parallel.halo`, :mod:`mpi4dl_tpu.ops.layers`);
+- the LP/PP send/recv pipeline (``src/torchgems/mp_pipeline.py``) becomes a
+  spatial front phase + a scan/switch/ppermute GPipe schedule inside one
+  jitted SPMD program (:class:`mpi4dl_tpu.parallel.pipeline.PipelineTrainer`);
+- GEMS-MASTER (``src/torchgems/gems_master.py``) becomes the mirrored dual
+  schedule :class:`mpi4dl_tpu.parallel.pipeline.GemsMasterTrainer`;
+- gradient sync (``SyncAllreduce``) disappears into ``jax.grad`` + ``psum``
+  (:mod:`mpi4dl_tpu.train`);
+- stage partitioning / shape discovery (``model_generator``) becomes
+  ``jax.eval_shape`` (:mod:`mpi4dl_tpu.parallel.partition`).
 """
 
 __version__ = "0.1.0"

@@ -152,9 +152,20 @@ class ParallelConfig:
         return tile_grid(self.spatial_parts, self.slice_method)
 
     @property
+    def lp_stages(self) -> int:
+        """Pipeline stages AFTER the spatial front (the ``pipe`` mesh axis
+        extent). The spatial stages don't occupy pipe coordinates: the spatial
+        front runs on ALL devices (tile axes for H/W, pipe axis reused as
+        extra micro-batch parallelism) before the LP pipeline drains — see
+        ``parallel/pipeline.py``. The reference instead gives the spatial
+        stage its own ranks (``mp_size = num_spatial_parts + split_size - 1``,
+        ``comm.py:59-67``), which idle during LP compute."""
+        return max(self.split_size - self.spatial_size, 1)
+
+    @property
     def mesh_shape(self) -> tuple[int, int, int, int]:
         th, tw = self.tile_shape
-        return (self.data_parallel, self.split_size, th, tw)
+        return (self.data_parallel, self.lp_stages, th, tw)
 
     @property
     def num_devices(self) -> int:

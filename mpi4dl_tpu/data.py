@@ -1,0 +1,100 @@
+"""Datasets for the benchmark entry points.
+
+Reference parity (``benchmark_amoebanet_sp.py:264-306``): ``--app`` selects
+1 = real medical images via ImageFolder at ``--datapath``, 2 = CIFAR-10,
+3 = synthetic fake data. The reference uses torchvision loaders; here the
+synthetic path is pure numpy (the benchmarks' hot path — every reference
+benchmark defaults to it), and the torchvision-backed paths are used when
+torchvision + data are actually present, else fall back to synthetic with a
+warning (the benchmark cluster has no egress).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+class SyntheticImages:
+    """Deterministic fake-data stream (ref ``torchvision.datasets.FakeData``
+    with ``transforms.ToTensor``: uniform [0,1) pixels). NHWC float32."""
+
+    def __init__(self, batch_size, image_size, num_classes, length=60000, seed=0):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.length = length
+        self.seed = seed
+
+    def __len__(self):
+        return max(self.length // self.batch_size, 1)
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(len(self)):
+            x = rng.random(
+                (self.batch_size, self.image_size, self.image_size, 3),
+                dtype=np.float32,
+            )
+            y = rng.integers(0, self.num_classes, size=(self.batch_size,)).astype(
+                np.int32
+            )
+            yield x, y
+
+
+def _torchvision_loader(kind, args, batch_size):
+    import torch
+    import torchvision
+    from torchvision import transforms
+
+    transform = transforms.Compose(
+        [
+            transforms.Resize((args.image_size, args.image_size)),
+            transforms.ToTensor(),
+        ]
+    )
+    if kind == "imagefolder":
+        ds = torchvision.datasets.ImageFolder(args.datapath, transform=transform)
+    else:
+        ds = torchvision.datasets.CIFAR10(
+            root=args.datapath, train=True, transform=transform, download=False
+        )
+    loader = torch.utils.data.DataLoader(
+        ds,
+        batch_size=batch_size,
+        shuffle=False,
+        num_workers=args.num_workers,
+        drop_last=True,
+    )
+
+    def gen():
+        for xb, yb in loader:
+            # torch NCHW -> NHWC numpy
+            yield (
+                np.ascontiguousarray(xb.numpy().transpose(0, 2, 3, 1)),
+                yb.numpy().astype(np.int32),
+            )
+
+    class _Wrap:
+        def __len__(self):
+            return len(loader)
+
+        def __iter__(self):
+            return gen()
+
+    return _Wrap()
+
+
+def get_dataset(args, batch_size, num_classes):
+    """Dataset iterable of (x NHWC f32, y i32) host batches."""
+    if args.app in (1, 2):
+        kind = "imagefolder" if args.app == 1 else "cifar"
+        try:
+            return _torchvision_loader(kind, args, batch_size)
+        except Exception as e:  # no torchvision / no data on this machine
+            print(
+                f"app={args.app} dataset unavailable ({e}); using synthetic",
+                file=sys.stderr,
+            )
+    return SyntheticImages(batch_size, args.image_size, num_classes)

@@ -36,7 +36,15 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from mpi4dl_tpu.ops.layers import Conv2d, Dense, Pool, TrainBatchNorm, TILE_AXES
+from mpi4dl_tpu.ops.layers import (
+    Conv2d,
+    Dense,
+    HaloExchange,
+    Identity,
+    Pool,
+    TrainBatchNorm,
+    TILE_AXES,
+)
 
 
 def _bn_axes(spatial: bool, cross_tile_bn: bool) -> tuple[str, ...]:
@@ -44,7 +52,12 @@ def _bn_axes(spatial: bool, cross_tile_bn: bool) -> tuple[str, ...]:
 
 
 class ResNetLayer(nn.Module):
-    """conv/BN/ReLU unit (ref ``resnet_layer``, ``resnet.py:24-78``)."""
+    """conv/BN/ReLU unit (ref ``resnet_layer``, ``resnet.py:24-78``).
+
+    ``exchange=False`` + ``padding=0`` turns the conv into the D2 "shrink"
+    form (VALID conv consuming pre-fetched halo, ref ``resnet_spatial_d2.py``),
+    and ``bn_interior`` excludes the remaining halo rows/cols from BN stats.
+    """
 
     features: int
     kernel_size: int = 3
@@ -53,21 +66,34 @@ class ResNetLayer(nn.Module):
     batch_normalization: bool = True
     conv_first: bool = True
     spatial: bool = False
+    exchange: bool = True
+    padding: Any = None
+    bn_interior: tuple[int, int] = (0, 0)
+    zero_halo: tuple[int, int] = (0, 0)  # re-zero outside-image halo pre-conv
     bn_reduce_axes: tuple[str, ...] = ()
     dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
+        from mpi4dl_tpu.parallel.halo import zero_boundary_halo
+
         conv = Conv2d(
             features=self.features,
             kernel_size=self.kernel_size,
             strides=self.strides,
+            padding=self.padding,
             spatial=self.spatial,
+            exchange=self.exchange,
             dtype=self.dtype,
             name="conv",
         )
         bn = (
-            TrainBatchNorm(reduce_axes=self.bn_reduce_axes, dtype=self.dtype, name="bn")
+            TrainBatchNorm(
+                reduce_axes=self.bn_reduce_axes,
+                interior=self.bn_interior,
+                dtype=self.dtype,
+                name="bn",
+            )
             if self.batch_normalization
             else None
         )
@@ -82,6 +108,8 @@ class ResNetLayer(nn.Module):
                 x = bn(x)
             if self.activation:
                 x = nn.relu(x)
+            if self.zero_halo != (0, 0):
+                x = zero_boundary_halo(x, *self.zero_halo)
             x = conv(x)
         return x
 
@@ -161,6 +189,117 @@ class CellV2(nn.Module):
         return x + y
 
 
+class CellV2D2(nn.Module):
+    """D2 (fused-halo) pre-activation bottleneck (ref ``make_cell_v2_spatial``
+    in ``resnet_spatial_d2.py:375-480``): the input tile already carries
+    ``halo_in`` rows/cols of neighbor data (fetched by one wide
+    ``HaloExchange`` shared across ``fused_layers`` cells); the two 3×3 convs
+    run VALID and shrink the halo by 2, the skip path is trimmed ``[2:-2]``
+    to match (ref ``:462-480``). BN statistics exclude the in-flight halo
+    (``bn_interior``) so results are bit-identical to the D1/plain model —
+    the reference accepts halo-skewed BN there.
+
+    Same parameter structure/names as :class:`CellV2` (r1-r4), so D1 golden
+    params drop in unchanged. Stride-2 cells are never fused (the builder
+    emits them as plain spatial cells)."""
+
+    res_block: int
+    features1: int
+    features2: int
+    halo_in: int
+    activation: str | None = "relu"
+    batch_normalization: bool = True
+    cross_tile_bn: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = self.halo_in
+        axes = TILE_AXES if self.cross_tile_bn else ()
+        common = dict(
+            spatial=True,
+            exchange=False,
+            padding=0,
+            bn_reduce_axes=axes,
+            dtype=self.dtype,
+            conv_first=False,
+        )
+        y = ResNetLayer(
+            self.features1,
+            activation=self.activation,
+            batch_normalization=self.batch_normalization,
+            bn_interior=(h, h),
+            zero_halo=(h, h),
+            name="r1",
+            **common,
+        )(x)
+        y = ResNetLayer(
+            self.features1,
+            bn_interior=(h - 1, h - 1),
+            zero_halo=(h - 1, h - 1),
+            name="r2",
+            **common,
+        )(y)
+        y = ResNetLayer(
+            self.features2,
+            kernel_size=1,
+            bn_interior=(h - 2, h - 2),
+            name="r3",
+            **common,
+        )(y)
+        x = x[:, 2:-2, 2:-2, :]
+        if self.res_block == 0:
+            x = ResNetLayer(
+                self.features2,
+                kernel_size=1,
+                activation=None,
+                batch_normalization=False,
+                name="r4",
+                spatial=True,
+                exchange=False,
+                padding=0,
+                dtype=self.dtype,
+            )(x)
+        return x + y
+
+
+def _v2_specs(depth: int) -> list[dict]:
+    """Per-cell specs of the v2 bottleneck stack (shared by the D1 and D2
+    builders so the two models cannot drift apart): strides/widths/activation
+    rules of ref ``get_resnet_v2`` (``resnet.py:270-323``)."""
+    if (depth - 2) % 9 != 0:
+        raise ValueError("depth should be 9n+2 (eg 56 or 110)")
+    n_blocks = (depth - 2) // 9
+    specs = []
+    features_in = 16  # bottleneck width, constant within a stage
+    for stage in range(3):
+        for res_block in range(n_blocks):
+            strides = 1
+            activation: str | None = "relu"
+            batch_normalization = True
+            if stage == 0:
+                features_out = features_in * 4
+                if res_block == 0:
+                    activation = None
+                    batch_normalization = False
+            else:
+                features_out = features_in * 2
+                if res_block == 0:
+                    strides = 2
+            specs.append(
+                dict(
+                    res_block=res_block,
+                    strides=strides,
+                    features1=features_in,
+                    features2=features_out,
+                    activation=activation,
+                    batch_normalization=batch_normalization,
+                )
+            )
+        features_in = features_out
+    return specs
+
+
 class HeadV1(nn.Module):
     """AvgPool(8) + Linear head (ref ``end_part_v1``, ``resnet.py:117-142``;
     logits instead of softmax — see module docstring)."""
@@ -197,6 +336,7 @@ def get_resnet_v1(
     num_classes: int = 10,
     spatial_cells: int = 0,
     cross_tile_bn: bool = True,
+    pool_kernel: int = 8,
     dtype: Any = jnp.float32,
 ) -> list[nn.Module]:
     """ResNet v1 as a flat cell list (ref ``get_resnet_v1``, ``resnet.py:145-178``).
@@ -233,7 +373,7 @@ def get_resnet_v1(
                 )
             )
         features *= 2
-    cells.append(HeadV1(num_classes=num_classes, dtype=dtype))
+    cells.append(HeadV1(num_classes=num_classes, pool_kernel=pool_kernel, dtype=dtype))
     return cells
 
 
@@ -242,12 +382,10 @@ def get_resnet_v2(
     num_classes: int = 10,
     spatial_cells: int = 0,
     cross_tile_bn: bool = True,
+    pool_kernel: int = 8,
     dtype: Any = jnp.float32,
 ) -> list[nn.Module]:
     """ResNet v2 as a flat cell list (ref ``get_resnet_v2``, ``resnet.py:270-323``)."""
-    if (depth - 2) % 9 != 0:
-        raise ValueError("depth should be 9n+2 (eg 56 or 110)")
-    n_blocks = (depth - 2) // 9
     cells: list[nn.Module] = []
 
     def sp():
@@ -262,34 +400,130 @@ def get_resnet_v2(
             dtype=dtype,
         )
     )
-    features_in = 16  # bottleneck width, constant within a stage
-    for stage in range(3):
-        for res_block in range(n_blocks):
-            strides = 1
-            activation: str | None = "relu"
-            batch_normalization = True
-            if stage == 0:
-                features_out = features_in * 4
-                if res_block == 0:
-                    activation = None
-                    batch_normalization = False
-            else:
-                features_out = features_in * 2
-                if res_block == 0:
-                    strides = 2
+    for spec in _v2_specs(depth):
+        cells.append(
+            CellV2(
+                spatial=sp(),
+                bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+                dtype=dtype,
+                **spec,
+            )
+        )
+    cells.append(HeadV2(num_classes=num_classes, pool_kernel=pool_kernel, dtype=dtype))
+    return cells
+
+
+def get_resnet_v2_d2(
+    depth: int,
+    num_classes: int = 10,
+    spatial_cells: int = 0,
+    fused_layers: int = 2,
+    cross_tile_bn: bool = True,
+    pool_kernel: int = 8,
+    dtype: Any = jnp.float32,
+) -> tuple[list[nn.Module], list[nn.Module], int]:
+    """ResNet v2 "design 2" (ref ``resnet_spatial_d2.py:578-726``): in the
+    spatial region, runs of up to ``fused_layers`` stride-1 bottleneck cells
+    share ONE wide :class:`~mpi4dl_tpu.ops.layers.HaloExchange` (halo
+    ``2*run``), then run halo-free shrink convs (:class:`CellV2D2`); stride-2
+    cells and the stem conv stay per-cell exchanged (D1 form). The reference
+    mutates ``balance[0]`` so its partitioner counts the inserted halo layers
+    (``:667-697``); here the front/back split point is returned explicitly.
+
+    spatial_cells counts **D1** cells (as produced by
+    ``PipelineTrainer.spatial_cell_count`` on the D1 cell list).
+
+    Returns ``(cells, plain_twin, n_spatial_d2)`` — ``plain_twin`` has
+    identical parameter structure (``Identity`` at halo positions) and is the
+    golden/init model; ``n_spatial_d2`` is the spatial prefix length in the
+    returned (expanded) cell list.
+    """
+    bn_axes = (lambda sp: TILE_AXES if (sp and cross_tile_bn) else ())
+    specs = _v2_specs(depth)  # shared with get_resnet_v2 — no drift
+
+    cells: list[nn.Module] = []
+    plain: list[nn.Module] = []
+    n_spatial_d2: int | None = None if spatial_cells > 0 else 0
+
+    sp0 = spatial_cells > 0
+    cells.append(
+        ResNetLayer(16, spatial=sp0, bn_reduce_axes=bn_axes(sp0), dtype=dtype)
+    )
+    plain.append(ResNetLayer(16, dtype=dtype))
+
+    i = 0
+    while i < len(specs):
+        in_spatial = (1 + i) < spatial_cells
+        if n_spatial_d2 is None and not in_spatial:
+            n_spatial_d2 = len(cells)
+        spec = specs[i]
+        if in_spatial and spec["strides"] == 1 and fused_layers > 1:
+            j = i
+            while (
+                j < len(specs)
+                and (1 + j) < spatial_cells
+                and specs[j]["strides"] == 1
+                and (j - i) < fused_layers
+            ):
+                j += 1
+            group = specs[i:j]
+            halo = 2 * len(group)
+            cells.append(HaloExchange(halo_len=halo))
+            plain.append(Identity())
+            for g_idx, gs in enumerate(group):
+                cells.append(
+                    CellV2D2(
+                        res_block=gs["res_block"],
+                        features1=gs["features1"],
+                        features2=gs["features2"],
+                        halo_in=halo - 2 * g_idx,
+                        activation=gs["activation"],
+                        batch_normalization=gs["batch_normalization"],
+                        cross_tile_bn=cross_tile_bn,
+                        dtype=dtype,
+                    )
+                )
+                plain.append(
+                    CellV2(
+                        res_block=gs["res_block"],
+                        strides=1,
+                        features1=gs["features1"],
+                        features2=gs["features2"],
+                        activation=gs["activation"],
+                        batch_normalization=gs["batch_normalization"],
+                        dtype=dtype,
+                    )
+                )
+            i = j
+        else:
             cells.append(
                 CellV2(
-                    res_block=res_block,
-                    strides=strides,
-                    features1=features_in,
-                    features2=features_out,
-                    activation=activation,
-                    batch_normalization=batch_normalization,
-                    spatial=sp(),
-                    bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+                    res_block=spec["res_block"],
+                    strides=spec["strides"],
+                    features1=spec["features1"],
+                    features2=spec["features2"],
+                    activation=spec["activation"],
+                    batch_normalization=spec["batch_normalization"],
+                    spatial=in_spatial,
+                    bn_reduce_axes=bn_axes(in_spatial),
                     dtype=dtype,
                 )
             )
-        features_in = features_out
-    cells.append(HeadV2(num_classes=num_classes, dtype=dtype))
-    return cells
+            plain.append(
+                CellV2(
+                    res_block=spec["res_block"],
+                    strides=spec["strides"],
+                    features1=spec["features1"],
+                    features2=spec["features2"],
+                    activation=spec["activation"],
+                    batch_normalization=spec["batch_normalization"],
+                    dtype=dtype,
+                )
+            )
+            i += 1
+
+    if n_spatial_d2 is None:
+        n_spatial_d2 = len(cells)
+    cells.append(HeadV2(num_classes=num_classes, pool_kernel=pool_kernel, dtype=dtype))
+    plain.append(HeadV2(num_classes=num_classes, pool_kernel=pool_kernel, dtype=dtype))
+    return cells, plain, n_spatial_d2

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi4dl_tpu.config import AXIS_TILE_H, AXIS_TILE_W
-from mpi4dl_tpu.parallel.halo import halo_exchange
+from mpi4dl_tpu.parallel.halo import halo_exchange, zero_boundary_halo
 
 TILE_AXES = (AXIS_TILE_H, AXIS_TILE_W)
 
@@ -71,6 +71,7 @@ class TrainBatchNorm(nn.Module):
 
     eps: float = 1e-5
     reduce_axes: tuple[str, ...] = ()
+    interior: tuple[int, int] = (0, 0)  # (halo_h, halo_w) rows/cols to EXCLUDE
     dtype: Any = None
 
     @nn.compact
@@ -78,10 +79,20 @@ class TrainBatchNorm(nn.Module):
         c = x.shape[-1]
         scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
-        red = tuple(range(x.ndim - 1))
         xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, red)
-        mean_sq = jnp.mean(jnp.square(xf), red)
+        # D2 fused-halo tiles carry `interior` rows/cols of neighbor data;
+        # excluding them from the statistics makes cross-tile (pmean) stats
+        # bit-identical to the plain model's — a correctness refinement over
+        # the reference, which lets halo pixels skew per-tile BN.
+        ih, iw = self.interior
+        stat_src = xf
+        if ih:
+            stat_src = stat_src[:, ih:-ih, :, :]
+        if iw:
+            stat_src = stat_src[:, :, iw:-iw, :]
+        red = tuple(range(x.ndim - 1))
+        mean = jnp.mean(stat_src, red)
+        mean_sq = jnp.mean(jnp.square(stat_src), red)
         if self.reduce_axes:
             mean = lax.pmean(mean, self.reduce_axes)
             mean_sq = lax.pmean(mean_sq, self.reduce_axes)
@@ -182,6 +193,7 @@ class Pool(nn.Module):
     strides: Any = None  # None → kernel_size (torch default)
     padding: Any = 0
     spatial: bool = False
+    count_include_pad: bool = True  # torch AvgPool2d default; AmoebaNet uses False
 
     @nn.compact
     def __call__(self, x):
@@ -196,6 +208,25 @@ class Pool(nn.Module):
             _check_window_coverage(kh, kw, sh, sw, ph, pw)
         if self.spatial and (ph or pw):
             fill = float("-inf") if self.kind == "max" else 0.0
+            if self.kind == "avg" and not self.count_include_pad:
+                # Exact distributed count_include_pad=False: average = ratio
+                # of two sum-pools. The divisor pool runs on a validity mask
+                # built LOCALLY from tile position (ones, zeroed on the
+                # outside-image ring of global-boundary tiles) — no second
+                # exchange needed; boundary windows then divide by the true
+                # (unpadded) element count at any tile position.
+                xe = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+                ones = zero_boundary_halo(
+                    jnp.ones_like(xe), ph, pw, AXIS_TILE_H, AXIS_TILE_W
+                )
+                num = lax.reduce_window(
+                    xe, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1), "valid"
+                )
+                den = lax.reduce_window(
+                    ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1), "valid"
+                )
+                y = num / den
+                return y[:, : h_loc // sh, : w_loc // sw, :]
             x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W, fill_value=fill)
             pad = ((0, 0), (0, 0))
         else:
@@ -204,9 +235,13 @@ class Pool(nn.Module):
         if self.kind == "max":
             y = nn.max_pool(x, (kh, kw), strides=(sh, sw), padding=pad)
         elif self.kind == "avg":
-            # count_include_pad=True parity: plain mean over the window,
-            # zeros included (torch AvgPool2d default).
-            y = nn.avg_pool(x, (kh, kw), strides=(sh, sw), padding=pad, count_include_pad=True)
+            y = nn.avg_pool(
+                x,
+                (kh, kw),
+                strides=(sh, sw),
+                padding=pad,
+                count_include_pad=self.count_include_pad,
+            )
         else:
             raise ValueError(f"unknown pool kind {self.kind!r}")
 
@@ -227,6 +262,16 @@ class HaloExchange(nn.Module):
     def __call__(self, x):
         ph, pw = _pair(self.halo_len)
         return halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+
+
+class Identity(nn.Module):
+    """Pass-through module. Used as the `none` genotype op (stride 1) and as
+    the plain twin of :class:`HaloExchange` (on the full image a halo
+    exchange is a no-op), keeping param-list positions aligned."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x
 
 
 class Dense(nn.Module):

@@ -109,3 +109,34 @@ def halo_exchange(
         from_right = _edge_fill(from_right, axis_w, lax.axis_size(axis_w) - 1)
         x = jnp.concatenate([from_left, x, from_right], axis=2)
     return x
+
+
+def zero_boundary_halo(x, halo_h: int, halo_w: int, axis_h: str = "tile_h", axis_w: str = "tile_w"):
+    """Zero the halo positions of a halo-carrying tile that lie OUTSIDE the
+    global image.
+
+    Needed for exact D1<->D2 equivalence: in the D1 (per-conv exchange) form
+    every conv zero-pads *after* the preceding BN+ReLU, while the D2 fused
+    form fetches the halo once up front — by conv time the boundary zeros
+    have been shifted by BN/ReLU. Re-zeroing the outside-image ring right
+    before each VALID conv restores the D1 semantics layer-by-layer (the
+    reference's D2 silently accepts this boundary divergence; we don't).
+    """
+    b, h, w, c = x.shape
+    if halo_h:
+        idx = lax.axis_index(axis_h)
+        n = lax.axis_size(axis_h)
+        row = jnp.arange(h)
+        outside = ((idx == 0) & (row < halo_h)) | (
+            (idx == n - 1) & (row >= h - halo_h)
+        )
+        x = jnp.where(outside[None, :, None, None], 0.0, x)
+    if halo_w:
+        idx = lax.axis_index(axis_w)
+        n = lax.axis_size(axis_w)
+        col = jnp.arange(w)
+        outside = ((idx == 0) & (col < halo_w)) | (
+            (idx == n - 1) & (col >= w - halo_w)
+        )
+        x = jnp.where(outside[None, None, :, None], 0.0, x)
+    return x

@@ -85,6 +85,30 @@ def init_cells(cells: Sequence[Any], rng, x) -> list[Any]:
     return out
 
 
+def eval_stage_shapes(cells: Sequence[Any], x):
+    """One ``jax.eval_shape`` pass over a cell list on abstract input ``x``
+    (pytree of ``ShapeDtypeStruct``). Returns ``(out_structs, shape_tree)``
+    where shape_tree mirrors the output pytree with plain shape tuples.
+
+    The single tracing primitive behind both :func:`trace_shapes` and the
+    pipeline's wire-shape planning — the replacement for the reference's
+    batch-1-zeros GPU dry-run (``get_output_shapes`` ``mp_pipeline.py:126-168``).
+    """
+    rng = jax.random.PRNGKey(0)
+
+    def run(xx):
+        vs = init_cells(cells, rng, xx)
+        return _apply_stage(cells, vs, xx)
+
+    out = jax.eval_shape(run, x)
+    shapes = jax.tree.map(
+        lambda s: tuple(s.shape),
+        out,
+        is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct),
+    )
+    return out, shapes
+
+
 def trace_shapes(
     cells: Sequence[Any],
     split_size: int,
@@ -102,19 +126,11 @@ def trace_shapes(
     import jax.numpy as jnp
 
     dtype = dtype or jnp.float32
-    stages = split_cells(cells, split_size, balance)
     x = jax.ShapeDtypeStruct(tuple(input_shape), dtype)
-    rng = jax.random.PRNGKey(0)
     shapes: list[Any] = []
-
-    for stage_cells in stages:
-
-        def run(xx, stage_cells=stage_cells):
-            vs = init_cells(stage_cells, rng, xx)
-            return _apply_stage(stage_cells, vs, xx)
-
-        x = jax.eval_shape(run, x)
-        shapes.append(jax.tree.map(lambda s: tuple(s.shape), x, is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct)))
+    for stage_cells in split_cells(cells, split_size, balance):
+        x, stage_shapes = eval_stage_shapes(stage_cells, x)
+        shapes.append(stage_shapes)
     return shapes
 
 

@@ -1,0 +1,586 @@
+"""Pipeline (LP/PP) engine: spatial front phase + GPipe fill-drain back phase.
+
+TPU-native replacement for the reference's ``train_model`` engine
+(``src/torchgems/mp_pipeline.py:171-538``) and its spatial subclass's routing
+(``train_spatial.py:1256-1458``). The reference runs one process per GPU,
+pre-allocates tagged recv buffers per micro-batch, and drives a fill-drain
+schedule with blocking MPI isend/irecv (``run_step`` ``mp_pipeline.py:509-534``:
+all forwards, then all backwards). Here the whole step is ONE jitted SPMD
+program over the mesh ``(data, pipe, tile_h, tile_w)``, in two phases:
+
+**Front phase (spatial stages).** All cells of stages ``0..spatial_size-1``
+run for ALL micro-batches up front, ``vmap``-ed over the micro-batch axis (so
+BatchNorm statistics stay per-micro-batch, exactly like the reference's
+``parts`` loop), H/W sharded over the tile axes with halo exchange, and the
+``pipe`` axis reused as extra micro-batch parallelism (micro-batches divide
+across pipe coordinates when ``parts % pipe == 0``; otherwise the front is
+computed replicated — correct, just redundant). The SP→LP join
+(``train_spatial.py:506-555, 1083-1188``) is the ``gather_tiles`` at the end
+of the front. Every collective in this phase executes unconditionally on
+every device — no divergent control flow around collectives, which the
+collective runtime rejects (and the reference would call a deadlock).
+
+Contrast with the reference topology: there the spatial stage owns its own
+ranks which idle while LP ranks compute (``comm.py:59-67``); here the front
+uses the whole mesh, then the whole mesh pipelines the back.
+
+**Back phase (LP pipeline).** The remaining collective-free stages run the
+GPipe fill-drain schedule:
+
+- stage placement   → ``lax.switch`` on ``lax.axis_index("pipe")``: each pipe
+  device executes its own stage body (heterogeneous shapes per stage are fine
+  because each switch branch un/re-flattens to its stage's static shapes);
+- activation send/recv (``mp_pipeline.py:294-432``) → per-boundary flat
+  "wire" buffers rotated with ``lax.ppermute`` each tick — exact sizes, no
+  tags, no waits;
+- micro-batch loop ("parts") → ``lax.scan`` over ``parts + stages - 1``
+  fill-drain ticks;
+- the backward schedule (``backward_pass`` ``mp_pipeline.py:475-507``) is not
+  hand-written at all: JAX AD transposes the scan+ppermute program into the
+  reverse drain automatically (transpose of a forward ppermute is the
+  backward grad hop the reference implements by hand);
+- per-stage activation memory is bounded by ``jax.checkpoint`` around each
+  stage body (recompute-in-backward; GPipe-standard), which also keeps
+  ``lax.switch`` residuals uniform across branches.
+
+GEMS mirror support: ``mirror=True`` places back-phase stage ``s`` on pipe
+device ``S-1-s`` and reverses wire flow — the reference's ``GEMS_INVERSE``
+rank arithmetic (``mp_pipeline.py:238-248``) reduced to an index map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi4dl_tpu.config import (
+    AXIS_DATA,
+    AXIS_PIPE,
+    AXIS_TILE_H,
+    AXIS_TILE_W,
+    ParallelConfig,
+)
+from mpi4dl_tpu.parallel.halo import gather_tiles
+from mpi4dl_tpu.parallel.partition import (
+    init_cells,
+    split_cells,
+    stage_bounds,
+)
+from mpi4dl_tpu.train import TrainState, correct_count, cross_entropy_sum, make_optimizer
+
+
+# -- pytree <-> flat vector plumbing ----------------------------------------
+
+
+class _TreeMeta:
+    """Static recipe to rebuild a pytree from one flat f32 vector."""
+
+    def __init__(self, tree):
+        leaves, self.treedef = jax.tree.flatten(tree)
+        self.shapes = [
+            tuple(l.shape) if hasattr(l, "shape") else np.shape(l) for l in leaves
+        ]
+        self.dtypes = [
+            l.dtype if hasattr(l, "dtype") else jnp.asarray(l).dtype for l in leaves
+        ]
+        self.sizes = [int(np.prod(s)) for s in self.shapes]
+        self.size = int(sum(self.sizes))
+
+    def flatten(self, tree) -> jax.Array:
+        leaves = jax.tree.leaves(tree)
+        if not leaves:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
+
+    def unflatten(self, vec: jax.Array):
+        out, off = [], 0
+        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
+            out.append(
+                lax.slice(vec, (off,), (off + size,)).reshape(shape).astype(dtype)
+            )
+            off += size
+        return jax.tree.unflatten(self.treedef, out)
+
+    @staticmethod
+    def of_shapes(shape_tree, dtype=jnp.float32):
+        """Meta for a pytree of shape-tuples (used for wire buffers)."""
+        return _TreeMeta(
+            jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(tuple(s), dtype),
+                shape_tree,
+                is_leaf=lambda s: isinstance(s, tuple)
+                and all(isinstance(i, int) for i in s),
+            )
+        )
+
+
+def _is_shape(s):
+    return isinstance(s, tuple) and all(isinstance(i, int) for i in s)
+
+
+class PipelineTrainer:
+    """Front-phase + GPipe back-phase trainer over
+    ``(data, pipe, tile_h, tile_w)``.
+
+    cells: flat cell list with spatial flags baked in (first
+        ``spatial_cell_count`` cells spatial when ``config.spatial_size > 0``;
+        use :meth:`spatial_cell_count` to build a matching model).
+    plain_cells: non-spatial twin for init + shape tracing (identical param
+        structure). Required when the model has spatial cells.
+    """
+
+    def __init__(
+        self,
+        cells: Sequence[Any],
+        config: ParallelConfig,
+        plain_cells: Sequence[Any] | None = None,
+        mesh=None,
+        learning_rate: float = 0.001,
+        momentum: float = 0.9,
+        remat: bool = True,
+        mirror: bool = False,
+        num_spatial_cells: int | None = None,
+    ):
+        if config.spatial_size:
+            if config.spatial_size >= config.split_size:
+                raise ValueError(
+                    "spatial stages must be followed by at least one LP stage "
+                    "(the join rank) — need spatial_size < split_size"
+                )
+        elif config.split_size < 2:
+            raise ValueError("PipelineTrainer needs split_size >= 2 (use Trainer)")
+        self.cells = list(cells)
+        self.plain_cells = list(plain_cells) if plain_cells is not None else self.cells
+        if len(self.plain_cells) != len(self.cells):
+            raise ValueError("plain_cells must mirror cells one-to-one")
+        self.config = config
+        self.mesh = mesh if mesh is not None else config.make_mesh()
+        self.tx = make_optimizer(learning_rate, momentum)
+        self.remat = remat
+        self.mirror = mirror
+
+        cfg = config
+        self.S = cfg.lp_stages  # back-phase pipeline depth == pipe axis extent
+        self.parts = cfg.parts
+        if cfg.batch_size % (cfg.parts * cfg.data_parallel):
+            raise ValueError("batch_size must divide by parts * data_parallel")
+        self.mb_local = cfg.batch_size // cfg.parts // cfg.data_parallel
+        if num_spatial_cells is not None:
+            # Explicit front length (e.g. D2 models whose expanded cell list
+            # no longer matches D1 stage bounds — the reference mutates
+            # balance[0] for the same reason, resnet_spatial_d2.py:667-697).
+            self.n_spatial_cells = num_spatial_cells
+            back = self.cells[self.n_spatial_cells :]
+            back_balance = (
+                list(cfg.balance)
+                if cfg.balance is not None and len(cfg.balance) == self.S
+                else None
+            )
+        else:
+            bounds = stage_bounds(len(self.cells), cfg.split_size, cfg.balance)
+            self.n_spatial_cells = self.spatial_cell_count(len(self.cells), cfg)
+            back = self.cells[self.n_spatial_cells :]
+            back_balance = (
+                [e - s for s, e in bounds[cfg.spatial_size :]]
+                if cfg.balance is not None or cfg.spatial_size
+                else None
+            )
+        self.front_cells = self.cells[: self.n_spatial_cells]
+        self.stages = split_cells(back, self.S, back_balance)
+        self._build_static_plan()
+        self._jit_step = jax.jit(self._train_step, donate_argnums=0)
+
+    # -- static planning -----------------------------------------------------
+    @staticmethod
+    def spatial_cell_count(num_cells: int, config: ParallelConfig) -> int:
+        """How many leading cells are spatial: all cells of stages
+        ``0..spatial_size-1`` (ref boundary logic ``resnet_spatial.py:545-633``:
+        spatial cells up to the SP stage's end layer)."""
+        if not config.spatial_size:
+            return 0
+        bounds = stage_bounds(num_cells, config.split_size, config.balance)
+        return bounds[config.spatial_size - 1][1]
+
+    def _build_static_plan(self):
+        """Trace the front output and per-boundary wire shapes via
+        ``jax.eval_shape`` on the plain twin (replaces the reference's
+        GPU dry-run + rescale dance, ``mp_pipeline.py:126-168`` +
+        ``train_spatial.py:61-238``)."""
+        cfg = self.config
+        x = jax.ShapeDtypeStruct(
+            (self.mb_local, cfg.image_size, cfg.image_size, 3), jnp.float32
+        )
+        rng = jax.random.PRNGKey(0)
+
+        def trace(cells, xx):
+            def run(xx):
+                vs = init_cells(cells, rng, xx)
+                for cell, v in zip(cells, vs):
+                    xx = cell.apply(v, xx)
+                return xx
+
+            out = jax.eval_shape(run, xx)
+            shapes = jax.tree.map(
+                lambda s: tuple(s.shape),
+                out,
+                is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct),
+            )
+            return out, shapes
+
+        plain_front = self.plain_cells[: self.n_spatial_cells]
+        plain_back = split_cells(
+            self.plain_cells[self.n_spatial_cells :],
+            self.S,
+            [len(st) for st in self.stages],
+        )
+        if plain_front:
+            x, self.front_out_shape = trace(plain_front, x)
+        else:
+            self.front_out_shape = tuple(x.shape)
+        boundary_shapes, out_shape = [], None
+        for si, stage in enumerate(plain_back):
+            x, shapes = trace(stage, x)
+            if si < self.S - 1:
+                boundary_shapes.append(shapes)
+            else:
+                out_shape = shapes
+        if not _is_shape(out_shape):
+            raise ValueError(f"final stage must emit logits, got {out_shape}")
+        self.num_classes = out_shape[-1]
+        self.wire_metas = [_TreeMeta.of_shapes(s) for s in boundary_shapes]
+
+    def _device_of_stage(self, s: int) -> int:
+        return (self.S - 1 - s) if self.mirror else s
+
+    # -- init ----------------------------------------------------------------
+    def init_params(self, rng, dtype=jnp.float32):
+        """Params = (front_flat, stacked_back [S, MAXP]). Front params are
+        replicated over ``pipe`` (every device computes the front); back-stage
+        rows are sharded over ``pipe``. Flattening gives ``lax.switch``
+        branches a uniform operand type (the reference GEMS engine flattens
+        whole-model params for one-shot P2P for the same reason,
+        ``train_spatial_master.py:117-138``)."""
+        cfg = self.config
+        x = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), dtype)
+        per_cell = init_cells(self.plain_cells, rng, x)
+        front_tree = per_cell[: self.n_spatial_cells]
+        back_per_stage = split_cells(
+            per_cell[self.n_spatial_cells :], self.S, [len(st) for st in self.stages]
+        )
+        self.front_meta = _TreeMeta(front_tree)
+        self.param_metas = [_TreeMeta(t) for t in back_per_stage]
+        self.max_p = max(m.size for m in self.param_metas)
+        front_flat = self.front_meta.flatten(front_tree)
+        rows = []
+        for meta, tree in zip(self.param_metas, back_per_stage):
+            flat = meta.flatten(tree)
+            rows.append(jnp.pad(flat, (0, self.max_p - meta.size)))
+        stacked = jnp.stack(rows)  # [S, MAXP]
+        order = [0] * self.S
+        for s in range(self.S):
+            order[self._device_of_stage(s)] = s
+        stacked = stacked[jnp.asarray(order)]
+        return (
+            jax.device_put(front_flat, NamedSharding(self.mesh, P())),
+            jax.device_put(stacked, NamedSharding(self.mesh, P(AXIS_PIPE, None))),
+        )
+
+    def init(self, rng, dtype=jnp.float32) -> TrainState:
+        params = self.init_params(rng, dtype)
+        return TrainState(
+            params=params,
+            opt_state=self.tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def unstack_params(self, params) -> list:
+        """(front, stacked) → flat per-cell variables list (tests /
+        checkpoints)."""
+        front_flat, stacked = params
+        out = list(self.front_meta.unflatten(jnp.asarray(front_flat)))
+        stacked = jnp.asarray(stacked)
+        for s in range(self.S):
+            row = stacked[self._device_of_stage(s)]
+            out.extend(self.param_metas[s].unflatten(row[: self.param_metas[s].size]))
+        return out
+
+    # -- front phase ---------------------------------------------------------
+    def _front(self, front_flat, x):
+        """Spatial stages on all micro-batches; returns [parts, mb, ...]
+        joined (full-image) activations, replicated over ``pipe``.
+
+        Micro-batches divide across pipe coordinates when possible (the
+        ``pipe`` axis moonlights as data parallelism for the front — the
+        LBANN-style trick the reference implements as LOCAL_DP_LP
+        scatter/gather, ``train_spatial.py:809-1028``, here in reverse);
+        otherwise every pipe device computes the full set redundantly.
+        """
+        if not self.front_cells:
+            return x
+        params = self.front_meta.unflatten(front_flat)
+        lp = self.S
+
+        def one_microbatch(xm):
+            h = xm
+            for cell, p in zip(self.front_cells, params):
+                h = cell.apply(p, h)
+            return jax.tree.map(gather_tiles, h)
+
+        shard_over_pipe = lp > 1 and self.parts % lp == 0
+        if shard_over_pipe:
+            chunk = self.parts // lp
+            pipe_idx = lax.axis_index(AXIS_PIPE)
+            my = jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, pipe_idx * chunk, chunk, 0), x
+            )
+        else:
+            my = x
+        out = jax.vmap(one_microbatch)(my)
+        if shard_over_pipe:
+            out = jax.tree.map(
+                lambda a: lax.all_gather(a, AXIS_PIPE, axis=0, tiled=True), out
+            )
+        return out
+
+    # -- back-phase stage bodies ---------------------------------------------
+    def _stage_fn(self, s: int):
+        cells = self.stages[s]
+        meta = self.param_metas[s]
+
+        def fn(flat_params, h):
+            params = meta.unflatten(flat_params[: meta.size])
+            for cell, p in zip(cells, params):
+                h = cell.apply(p, h)
+            return h
+
+        return jax.checkpoint(fn) if self.remat else fn
+
+    def _make_branch(self, s: int):
+        """Switch branch for pipe devices hosting back-stage ``s``: consume
+        this tick's input (front output for stage 0, wire ``s-1`` otherwise),
+        emit wire ``s`` (or logits for the last stage)."""
+        stage = self._stage_fn(s)
+        wire_metas = self.wire_metas
+
+        def branch(flat_params, wires, x_mb):
+            if s == 0:
+                inp = x_mb
+            else:
+                inp = wire_metas[s - 1].unflatten(wires[s - 1])
+            out = stage(flat_params, inp)
+            new_wires = [jnp.zeros_like(w) for w in wires]
+            if s < self.S - 1:
+                new_wires[s] = wire_metas[s].flatten(out)
+                logits = jnp.zeros((self.mb_local, self.num_classes), jnp.float32)
+            else:
+                logits = out.astype(jnp.float32)
+            return tuple(new_wires), logits
+
+        return branch
+
+    # -- the schedule --------------------------------------------------------
+    def _schedule(self, flat, front_out, mirror: bool):
+        """Fill-drain over one chunk. Returns ``(preds, stage_of)`` — preds
+        valid only on the last stage's devices, callers mask with
+        ``stage_of == S-1``."""
+        S, parts = self.S, self.parts
+        pipe_idx = lax.axis_index(AXIS_PIPE)
+        stage_of = (S - 1 - pipe_idx) if mirror else pipe_idx
+
+        def dev_of(s):
+            return (S - 1 - s) if mirror else s
+
+        branches = [self._make_branch(s) for s in range(S)]
+        wires0 = tuple(jnp.zeros((m.size,), jnp.float32) for m in self.wire_metas)
+        preds0 = jnp.zeros((parts, self.mb_local, self.num_classes), jnp.float32)
+        perm = [(dev_of(s), dev_of(s + 1)) for s in range(S - 1)]
+
+        def tick(carry, t):
+            wires, preds = carry
+            m0 = jnp.clip(t, 0, parts - 1)
+            x_mb = jax.tree.map(lambda a: a[m0], front_out)
+            new_wires, logits = lax.switch(stage_of, branches, flat, wires, x_mb)
+            m = t - stage_of
+            valid_last = (stage_of == S - 1) & (m >= 0) & (m < parts)
+            mc = jnp.clip(m, 0, parts - 1)
+            preds = jnp.where(
+                valid_last,
+                lax.dynamic_update_index_in_dim(preds, logits, mc, 0),
+                preds,
+            )
+            sent = tuple(
+                lax.ppermute(w, AXIS_PIPE, [pair]) for pair, w in zip(perm, new_wires)
+            )
+            return (sent, preds), None
+
+        (_, preds), _ = lax.scan(tick, (wires0, preds0), jnp.arange(parts + S - 1))
+        return preds, stage_of
+
+    def _contributions(self, preds, y, stage_of):
+        """Per-device (ce_sum, correct) masked to the last stage — pre-psum."""
+        is_last = stage_of == self.S - 1
+        logits_all = preds.reshape(-1, self.num_classes)
+        labels = y.reshape(-1)
+        zero = jnp.zeros((), jnp.float32)
+        ce = jnp.where(is_last, cross_entropy_sum(logits_all, labels), zero)
+        cc = jnp.where(
+            is_last, correct_count(logits_all, labels).astype(jnp.float32), zero
+        )
+        return ce, cc
+
+    def _reduce_metrics(self, ce, cc, n_examples_local):
+        """psum-of-contributions normalization (see ``train.Trainer``)."""
+        replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
+        denom = n_examples_local * lax.axis_size(AXIS_DATA) * replicas
+        axes = (AXIS_DATA, AXIS_PIPE, AXIS_TILE_H, AXIS_TILE_W)
+        return lax.psum(ce / denom, axes), lax.psum(cc / denom, axes)
+
+    def _local_loss(self, params, x, y):
+        """Runs inside shard_map. x: [parts, mb_local, H(/th), W(/tw), C]
+        local tile of the micro-batched input; y: [parts, mb_local]."""
+        front_flat, stacked_local = params
+        flat = stacked_local[0]  # [MAXP] — this device's back-stage params
+        front_out = self._front(front_flat, x)
+        preds, stage_of = self._schedule(flat, front_out, self.mirror)
+        ce, cc = self._contributions(preds, y, stage_of)
+        return self._reduce_metrics(ce, cc, self.parts * self.mb_local)
+
+    # -- step ----------------------------------------------------------------
+    @property
+    def x_spec(self):
+        if self.n_spatial_cells > 0:
+            return P(None, AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W, None)
+        return P(None, AXIS_DATA, None, None, None)
+
+    @property
+    def y_spec(self):
+        return P(None, AXIS_DATA)
+
+    def _sharded_loss(self, params, x, y):
+        fn = shard_map(
+            self._local_loss,
+            mesh=self.mesh,
+            in_specs=((P(), P(AXIS_PIPE, None)), self.x_spec, self.y_spec),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return fn(params, x, y)
+
+    def _train_step(self, state: TrainState, x, y):
+        def loss_fn(params):
+            return self._sharded_loss(params, x, y)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            {"loss": loss, "accuracy": acc},
+        )
+
+    def train_step(self, state: TrainState, x, y):
+        return self._jit_step(state, x, y)
+
+    def shard_batch(self, x, y):
+        """[B, H, W, C] → micro-batched [parts, mb, H, W, C] placed on the
+        mesh (batch over ``data``, H/W over tile axes for spatial configs)."""
+        b = x.shape[0]
+        x = x.reshape((self.parts, b // self.parts) + tuple(x.shape[1:]))
+        y = y.reshape((self.parts, b // self.parts))
+        xs = jax.device_put(x, NamedSharding(self.mesh, self.x_spec))
+        ys = jax.device_put(y, NamedSharding(self.mesh, self.y_spec))
+        return xs, ys
+
+
+class GemsMasterTrainer(PipelineTrainer):
+    """GEMS-MASTER: bidirectional pipeline pairs (ref ``train_model_master``,
+    ``gems_master.py:23-103``, and the SP flavor ``train_spatial_model_master``,
+    ``train_spatial_master.py:87-501``).
+
+    The reference keeps TWO model replicas resident: model2's stage ``s``
+    lives on rank ``mp_size-1-s`` (``GEMS_INVERSE``), the pair alternates
+    half-batches, and gradients merge through carefully ordered allreduces
+    (``comm.py:460-504``) — or through pairwise flat-parameter/grad P2P in the
+    ``--enable-master-comm-opt`` path (``train_spatial_master.py:229-455``).
+
+    TPU-native form: ONE parameter copy. The reverse direction materializes
+    its stage row by a mirror ``ppermute`` of the stacked per-stage params
+    over the pipe axis — which *is* the comm-opt pairwise exchange, expressed
+    as a collective; its AD transpose routes the reverse-direction gradients
+    back to the owning devices, replacing both hand-written allreduce
+    orderings and the deadlock-avoidance dance. The step runs ``2 × times``
+    chunks (ref ``--times`` replication, ``gems_master.py:87-102``),
+    alternating normal/mirrored placement, in one jitted program: effective
+    batch ``2·times·batch_size`` at one parameter copy's memory.
+
+    SP+GEMS composes for free: the spatial front is direction-agnostic, so
+    the reference's rank-disjointness constraint ``mp_size ≥ 2×spatial_parts``
+    (``verify_spatial_master_config``, ``train_spatial_master.py:33-84``) has
+    no analog here — any SP config can run GEMS.
+
+    Note: with the scan-based engine, plain GPipe already fills bubbles by
+    raising ``parts`` at no extra memory (remat), so bidirectionality is kept
+    for capability/CLI parity and for the mirrored-placement machinery GEMS
+    needs, not because bubbles demand it.
+    """
+
+    @property
+    def chunks(self) -> int:
+        return 2 * self.config.times
+
+    @property
+    def x_spec(self):
+        if self.n_spatial_cells > 0:
+            return P(None, None, AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W, None)
+        return P(None, None, AXIS_DATA, None, None, None)
+
+    @property
+    def y_spec(self):
+        return P(None, None, AXIS_DATA)
+
+    def _local_loss(self, params, x, y):
+        """x: [2*times, parts, mb_local, ...]; chunk 2k → normal direction,
+        chunk 2k+1 → mirrored (ref alternation, ``gems_master.py:72-103``)."""
+        front_flat, stacked_local = params
+        S = self.S
+        flat = stacked_local[0]
+        # Mirror exchange: device p receives device (S-1-p)'s stage params.
+        flipped = lax.ppermute(
+            stacked_local, AXIS_PIPE, [(i, S - 1 - i) for i in range(S)]
+        )[0]
+        ce_tot = jnp.zeros((), jnp.float32)
+        cc_tot = jnp.zeros((), jnp.float32)
+        for c in range(self.chunks):
+            xc = jax.tree.map(lambda a: a[c], x)
+            yc = y[c]
+            front_out = self._front(front_flat, xc)
+            mirror = bool(c % 2)
+            preds, stage_of = self._schedule(
+                flipped if mirror else flat, front_out, mirror
+            )
+            ce, cc = self._contributions(preds, yc, stage_of)
+            ce_tot += ce
+            cc_tot += cc
+        n_local = self.chunks * self.parts * self.mb_local
+        return self._reduce_metrics(ce_tot, cc_tot, n_local)
+
+    def shard_batch(self, x, y):
+        """[2*times*B, H, W, C] → [2*times, parts, mb, H, W, C] on the mesh."""
+        b = x.shape[0]
+        if b % self.chunks:
+            raise ValueError(
+                f"GEMS batch must be 2*times*batch_size = {self.chunks} chunks"
+            )
+        per = b // self.chunks
+        x = x.reshape((self.chunks, self.parts, per // self.parts) + tuple(x.shape[1:]))
+        y = y.reshape((self.chunks, self.parts, per // self.parts))
+        xs = jax.device_put(x, NamedSharding(self.mesh, self.x_spec))
+        ys = jax.device_put(y, NamedSharding(self.mesh, self.y_spec))
+        return xs, ys

@@ -113,6 +113,12 @@ def get_parser() -> argparse.ArgumentParser:
         help="Data loading workers (kept for CLI parity)",
     )
     parser.add_argument(
+        "--max-steps",
+        type=int,
+        default=None,
+        help="Stop after N steps per epoch (TPU-native addition for smoke runs)",
+    )
+    parser.add_argument(
         "--precision",
         type=str,
         default="bf16",

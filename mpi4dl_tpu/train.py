@@ -97,9 +97,11 @@ class Trainer:
         mesh=None,
         learning_rate: float = 0.001,
         momentum: float = 0.9,
+        remat: bool = False,
     ):
         if num_spatial_cells > 0 and plain_cells is None:
             raise ValueError("spatial models need plain_cells for initialization")
+        self.remat = remat
         self.cells = list(cells)
         self.plain_cells = list(plain_cells) if plain_cells is not None else self.cells
         self.n_spatial = num_spatial_cells
@@ -144,7 +146,8 @@ class Trainer:
         for i, cell in enumerate(self.cells):
             if i == self.n_spatial and self.n_spatial > 0:
                 h = gather_tiles(h)
-            h = cell.apply(params[i], h)
+            apply = jax.checkpoint(cell.apply) if self.remat else cell.apply
+            h = apply(params[i], h)
         logits = h
 
         d = lax.axis_size(AXIS_DATA)
@@ -190,21 +193,31 @@ class Trainer:
         return self._jit_step(state, x, y)
 
 
-def single_device_step(cells: Sequence[Any], learning_rate=0.001, momentum=0.9):
+def single_device_step(cells: Sequence[Any], learning_rate=0.001, momentum=0.9, parts=1):
     """Golden single-device train step (tests compare distributed runs
     against this — the role the reference's sequential-conv golden runs play
-    in ``benchmark_sp_halo_exchange_with_compute_val.py:704-780``)."""
+    in ``benchmark_sp_halo_exchange_with_compute_val.py:704-780``).
+
+    parts > 1 reproduces micro-batched semantics: each micro-batch flows
+    through the model separately (so BatchNorm statistics are per
+    micro-batch, exactly like the pipeline schedule and the reference's
+    ``parts`` loop, ``mp_pipeline.py:509-534``), losses averaged.
+    """
     tx = make_optimizer(learning_rate, momentum)
 
     @jax.jit
     def step(state: TrainState, x, y):
         def loss_fn(params):
-            logits = apply_cells(cells, params, x)
             b = y.shape[0]
-            return (
-                cross_entropy_sum(logits, y) / b,
-                correct_count(logits, y).astype(jnp.float32) / b,
-            )
+            xm = x.reshape((parts, b // parts) + tuple(x.shape[1:]))
+            ym = y.reshape((parts, b // parts))
+            ce = jnp.zeros((), jnp.float32)
+            cc = jnp.zeros((), jnp.float32)
+            for m in range(parts):
+                logits = apply_cells(cells, params, xm[m])
+                ce += cross_entropy_sum(logits, ym[m])
+                cc += correct_count(logits, ym[m]).astype(jnp.float32)
+            return ce / b, cc / b
 
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

@@ -1,5 +1,25 @@
 """Small helpers (parity with reference ``src/torchgems/utils.py``)."""
 
+import os
+import re
+
+
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` / ``--xla_force_host_platform_device_count``
+    even when a site-initialized TPU plugin has already force-set
+    ``jax_platforms`` through ``jax.config`` (which silently overrides the
+    environment). Call before first device use in CLI entry points.
+    """
+    import jax
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+
 
 def is_power_two(n: int) -> bool:
     """True iff n is a positive power of two (ref ``utils.py:20-21``)."""
