@@ -96,7 +96,12 @@ def build_amoebanet(args, cfg, spatial_cells=0):
         num_filters=args.num_filters,
     )
     return (
-        amoebanetd(spatial_cells=spatial_cells, dtype=dtype, **kw),
+        amoebanetd(
+            spatial_cells=spatial_cells,
+            halo_d2=args.halo_d2 and spatial_cells > 0,
+            dtype=dtype,
+            **kw,
+        ),
         amoebanetd(dtype=jnp.float32, **kw),
     )
 
@@ -148,11 +153,14 @@ def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=No
 
 def run_training(args, trainer, tag: str):
     """Epoch loop with per-step wall-clock timing (ref
-    ``benchmark_amoebanet_sp.py:315-367``)."""
+    ``benchmark_amoebanet_sp.py:315-367``), optional checkpoint/resume and
+    ``jax.profiler`` tracing (TPU-native additions)."""
     import jax
     import jax.numpy as jnp
 
+    from mpi4dl_tpu import checkpoint as ckpt
     from mpi4dl_tpu.data import get_dataset
+    from mpi4dl_tpu.profiling import trace
 
     cfg = trainer.config
     chunks = getattr(trainer, "chunks", 1)
@@ -166,26 +174,38 @@ def run_training(args, trainer, tag: str):
             jax.random.PRNGKey(0),
             (global_batch, cfg.image_size, cfg.image_size, 3),
         )
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    if ckpt_dir and getattr(args, "resume", False):
+        try:
+            state = ckpt.restore_checkpoint(ckpt_dir, state)
+            print(f"resumed from step {int(state.step)}")
+        except FileNotFoundError:
+            pass
 
     perf = []
-    for epoch in range(args.num_epochs):
-        for step, (x, y) in enumerate(ds):
-            xs, ys = trainer.shard_batch(jnp.asarray(x), jnp.asarray(y))
-            t0 = time.perf_counter()
-            state, metrics = trainer.train_step(state, xs, ys)
-            loss = float(metrics["loss"])  # blocks
-            dt = time.perf_counter() - t0
-            if step > 0:  # skip compile step, like the reference's warmup
-                perf.append(global_batch / dt)
-            if args.verbose:
-                print(
-                    f"epoch {epoch} step {step}: loss {loss:.4f} "
-                    f"acc {float(metrics['accuracy']):.4f} "
-                    f"({global_batch / dt:.3f} img/s)"
-                )
-            max_steps = getattr(args, "max_steps", None)
-            if max_steps is not None and step + 1 >= max_steps:
-                break
+    with trace(getattr(args, "trace_dir", None)):
+        for epoch in range(args.num_epochs):
+            for step, (x, y) in enumerate(ds):
+                xs, ys = trainer.shard_batch(jnp.asarray(x), jnp.asarray(y))
+                t0 = time.perf_counter()
+                state, metrics = trainer.train_step(state, xs, ys)
+                loss = float(metrics["loss"])  # blocks
+                dt = time.perf_counter() - t0
+                if step > 0:  # skip compile step, like the reference's warmup
+                    perf.append(global_batch / dt)
+                if args.verbose:
+                    print(
+                        f"epoch {epoch} step {step}: loss {loss:.4f} "
+                        f"acc {float(metrics['accuracy']):.4f} "
+                        f"({global_batch / dt:.3f} img/s)"
+                    )
+                if ckpt_dir and int(state.step) % args.checkpoint_every == 0:
+                    ckpt.save_checkpoint(ckpt_dir, state)
+                max_steps = getattr(args, "max_steps", None)
+                if max_steps is not None and step + 1 >= max_steps:
+                    break
+    if ckpt_dir:
+        ckpt.save_checkpoint(ckpt_dir, state)
     if perf:
         print(
             f"{tag}: Mean {statistics.mean(perf):.3f} img/s "

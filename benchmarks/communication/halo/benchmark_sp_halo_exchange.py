@@ -37,6 +37,13 @@ def get_args():
     p.add_argument("--halo-len", type=int, default=1)
     p.add_argument("--iterations", type=int, default=100)
     p.add_argument("--warmup", type=int, default=10)
+    p.add_argument(
+        "--impl",
+        type=str,
+        default="xla",
+        choices=["xla", "pallas"],
+        help="xla = ppermute shifts; pallas = bidirectional remote-DMA kernel",
+    )
     return p.parse_args()
 
 
@@ -76,7 +83,7 @@ def main():
         shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )
     def exchange_keep_halo(x):
-        p = halo_exchange(x, h, h, "tile_h", "tile_w")
+        p = halo_exchange(x, h, h, "tile_h", "tile_w", impl=args.impl)
         # shard_map out shapes must tile evenly: crop the *interior overlap*
         # instead — each tile returns its padded tile's top-left corner of
         # tile size, i.e. rows/cols [0 : H_loc] of the padded tile.
@@ -113,7 +120,7 @@ def main():
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) * 1e3)
     print(
-        f"halo exchange {s}x{s} halo={h} {args.slice_method} x{n}: "
+        f"halo exchange[{args.impl}] {s}x{s} halo={h} {args.slice_method} x{n}: "
         f"mean {statistics.mean(times):.4f} ms  median {statistics.median(times):.4f} ms"
     )
 

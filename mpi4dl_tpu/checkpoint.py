@@ -1,0 +1,103 @@
+"""Checkpoint / resume.
+
+The reference has NO persistence at all — no ``torch.save``/``load`` anywhere
+(SURVEY.md §5.4); a crashed run restarts from scratch, and initial weight
+consistency is re-established by broadcast every launch
+(``src/torchgems/comm.py:368-400``). A framework for multi-day
+high-resolution training needs real checkpointing, so this subsystem is a
+deliberate capability *addition* over the reference.
+
+Format: one directory per step (``step_0000100/``) holding
+
+- ``state.msgpack`` — the full ``TrainState`` pytree (params, optimizer
+  state, step) via ``flax.serialization`` (framework-independent msgpack,
+  no pickling of code);
+- ``meta.json`` — step number + user metadata.
+
+Arrays are pulled to host before writing (``jax.device_get``), so saving
+works identically for sharded (multi-chip) and single-device states; on
+restore the caller re-shards by construction (``Trainer``/``PipelineTrainer``
+place params via their own ``NamedSharding``s on the next ``train_step``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any
+
+import jax
+from flax import serialization
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    state: Any,
+    step: int | None = None,
+    keep: int = 3,
+    metadata: dict | None = None,
+) -> str:
+    """Write ``state`` under ``ckpt_dir/step_{step}``; prune to ``keep``
+    newest. Returns the checkpoint path. ``step`` defaults to
+    ``int(state.step)``."""
+    if step is None:
+        step = int(jax.device_get(state.step))
+    path = os.path.join(ckpt_dir, f"step_{step:08d}")
+    tmp = path + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    host_state = jax.device_get(state)
+    with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(host_state))
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump({"step": step, **(metadata or {})}, f)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)  # atomic publish — no torn checkpoints on crash
+    _prune(ckpt_dir, keep)
+    return path
+
+
+def _prune(ckpt_dir: str, keep: int) -> None:
+    steps = all_checkpoints(ckpt_dir)
+    for step, path in steps[: max(len(steps) - keep, 0)]:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def all_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
+    """Sorted ``(step, path)`` list of complete checkpoints."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_DIR.match(name)
+        if m and os.path.exists(os.path.join(ckpt_dir, name, "state.msgpack")):
+            out.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    return sorted(out)
+
+
+def latest_checkpoint(ckpt_dir: str) -> str | None:
+    steps = all_checkpoints(ckpt_dir)
+    return steps[-1][1] if steps else None
+
+
+def restore_checkpoint(path_or_dir: str, target: Any) -> Any:
+    """Restore a state pytree. ``target`` supplies the structure (a freshly
+    ``init()``-ed ``TrainState``); pass a checkpoint path or a directory (→
+    newest). Raises ``FileNotFoundError`` when nothing is there."""
+    path = path_or_dir
+    if not os.path.exists(os.path.join(path, "state.msgpack")):
+        newest = latest_checkpoint(path_or_dir)
+        if newest is None:
+            raise FileNotFoundError(f"no checkpoint under {path_or_dir!r}")
+        path = newest
+    with open(os.path.join(path, "state.msgpack"), "rb") as f:
+        return serialization.from_bytes(target, f.read())
+
+
+def checkpoint_metadata(path: str) -> dict:
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)

@@ -138,6 +138,19 @@ class ParallelConfig:
         if self.balance is not None:
             if len(self.balance) != self.split_size:
                 raise ValueError("balance list length must equal split_size")
+        if self.local_dp < 1:
+            raise ValueError("local_dp must be >= 1")
+        if self.local_dp > 1:
+            # LBANN-style local DP (ref LOCAL_DP_LP, train_spatial.py:809-1028):
+            # the post-join LP stages batch-shard over the spatial devices.
+            if not self.spatial_size:
+                raise ValueError("local_dp > 1 requires a spatial front")
+            th, tw = tile_grid(max(self.num_spatial_parts), self.slice_method)
+            if self.local_dp != th * tw:
+                raise ValueError(
+                    f"local_dp must equal the spatial device count {th * tw} "
+                    "(the LP stages batch-shard over the tile axes)"
+                )
 
     # -- derived geometry ---------------------------------------------------
     @property

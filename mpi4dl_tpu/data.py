@@ -18,29 +18,91 @@ import numpy as np
 
 class SyntheticImages:
     """Deterministic fake-data stream (ref ``torchvision.datasets.FakeData``
-    with ``transforms.ToTensor``: uniform [0,1) pixels). NHWC float32."""
+    with ``transforms.ToTensor``: uniform [0,1) pixels). NHWC float32.
 
-    def __init__(self, batch_size, image_size, num_classes, length=60000, seed=0):
+    Batch synthesis runs in the native multithreaded runtime when built
+    (:mod:`mpi4dl_tpu.native`; counter-based RNG, thread-count independent),
+    with a one-batch-deep background prefetch thread so host synthesis
+    overlaps device compute — the role of the reference's DataLoader
+    ``--num-workers``. Falls back to single-threaded numpy.
+    """
+
+    def __init__(
+        self,
+        batch_size,
+        image_size,
+        num_classes,
+        length=60000,
+        seed=0,
+        prefetch=True,
+    ):
         self.batch_size = batch_size
         self.image_size = image_size
         self.num_classes = num_classes
         self.length = length
         self.seed = seed
+        self.prefetch = prefetch
 
     def __len__(self):
         return max(self.length // self.batch_size, 1)
 
+    def _make_batch(self, i):
+        from mpi4dl_tpu import native
+
+        x = native.fill_uniform(
+            (self.batch_size, self.image_size, self.image_size, 3),
+            seed=self.seed * 1_000_003 + i,
+        )
+        y = native.fill_labels(
+            self.batch_size, self.num_classes, seed=self.seed * 7_000_003 + i
+        )
+        return x, y
+
     def __iter__(self):
-        rng = np.random.default_rng(self.seed)
-        for _ in range(len(self)):
-            x = rng.random(
-                (self.batch_size, self.image_size, self.image_size, 3),
-                dtype=np.float32,
-            )
-            y = rng.integers(0, self.num_classes, size=(self.batch_size,)).astype(
-                np.int32
-            )
-            yield x, y
+        if not self.prefetch:
+            for i in range(len(self)):
+                yield self._make_batch(i)
+            return
+
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=2)
+        stop = threading.Event()
+        n = len(self)
+
+        def producer():
+            try:
+                for i in range(n):
+                    item = (None, self._make_batch(i))
+                    # Bounded put so an abandoned consumer (early break in the
+                    # epoch loop) doesn't pin this thread + batches forever.
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # propagate instead of hanging q.get
+                q.put((e, None))
+                return
+            q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                err, batch = item
+                if err is not None:
+                    raise err
+                yield batch
+        finally:
+            stop.set()  # runs on generator close/GC too — unblocks producer
 
 
 def _torchvision_loader(kind, args, batch_size):

@@ -36,7 +36,14 @@ from typing import Any, Callable, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
-from mpi4dl_tpu.ops.layers import Conv2d, Identity, Pool, TrainBatchNorm, TILE_AXES
+from mpi4dl_tpu.ops.layers import (
+    Conv2d,
+    HaloExchange,
+    Identity,
+    Pool,
+    TrainBatchNorm,
+    TILE_AXES,
+)
 
 
 def _bn_axes(spatial: bool, cross_tile_bn: bool) -> tuple[str, ...]:
@@ -343,12 +350,249 @@ class AmoebaCell(nn.Module):
         return jnp.concatenate([states[i] for i in concat], axis=-1), skip
 
 
+# -- D2 (fused-halo) design --------------------------------------------------
+#
+# Reference ``src/models/amoebanet_d2.py`` (``Cell_D2`` ``:569-678``,
+# padding-free op variants ``:88-313``, genotype ``NORMAL_OPERATIONS_D2``
+# ``:389-456``): instead of a halo exchange inside every windowed op of every
+# normal cell, the cell pre-fetches wide halos with standalone exchanges
+# (there: halo 3 + halo 2 states) and runs the ops VALID, cropping as the
+# halo shrinks. Here the same amortization is *derived* rather than
+# hand-tabled: ``_plan_state_halos`` walks the genotype backwards and
+# computes, per cell state, the widest halo any consumer chain needs; the
+# two input states are exchanged ONCE at that width and every op crops its
+# source down to (its target's halo + its own window need). Boundary
+# semantics stay bit-exact with the per-op (D1) form by re-filling the
+# outside-image ring before every windowed op (``fill_boundary_halo``) and
+# masking in-flight halo out of BN statistics — divergences the reference's
+# D2 silently accepts.
+
+
+class ConvBranchD2(nn.Module):
+    """D2 twin of :class:`ConvBranch`: input carries ``halo_in`` rows/cols of
+    neighbor data; each conv runs VALID and shrinks the halo by its D1
+    padding. Parameter names match :class:`ConvBranch` exactly (``conv{i}`` /
+    ``bn{i}``) so plain-model parameters drop in unchanged."""
+
+    channels: int
+    convs: Sequence[tuple[Any, Any, Any]]  # (kernel, stride, d1_padding)
+    halo_in: int
+    bottleneck: bool = False
+    bn_reduce_axes: tuple[str, ...] = ()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        from mpi4dl_tpu.parallel.halo import fill_boundary_halo
+
+        c = self.channels
+        inner = c // 4 if self.bottleneck else c
+        hh = hw = self.halo_in
+        common = dict(use_bias=False, spatial=True, exchange=False, dtype=self.dtype)
+
+        def bn(idx):
+            return TrainBatchNorm(
+                reduce_axes=self.bn_reduce_axes,
+                interior=(hh, hw),
+                dtype=self.dtype,
+                name=f"bn{idx}",
+            )
+
+        idx = 0
+        if self.bottleneck:
+            x = nn.relu(x)
+            x = Conv2d(features=inner, kernel_size=1, padding=0, name=f"conv{idx}", **common)(x)
+            x = bn(idx)(x)
+            idx += 1
+        for k, s, p in self.convs:
+            if _pair_(s) != (1, 1):
+                raise ValueError("D2 conv branches are stride-1 only")
+            ph, pw = _pair_(p)
+            x = nn.relu(x)
+            if (hh or hw) and (ph or pw):
+                x = fill_boundary_halo(x, hh, hw, 0.0)
+            x = Conv2d(features=inner, kernel_size=k, strides=1, padding=0, name=f"conv{idx}", **common)(x)
+            hh -= ph
+            hw -= pw
+            if hh < 0 or hw < 0:
+                raise ValueError("halo_in too small for this conv branch")
+            x = bn(idx)(x)
+            idx += 1
+        if self.bottleneck:
+            x = nn.relu(x)
+            x = Conv2d(features=c, kernel_size=1, padding=0, name=f"conv{idx}", **common)(x)
+            x = bn(idx)(x)
+        return x
+
+
+class PoolD2(nn.Module):
+    """D2 twin of :class:`~mpi4dl_tpu.ops.layers.Pool` for 3×3 stride-1
+    pad-1 pools: input carries ``halo_in``, output carries ``halo_in - 1``.
+    Outside-image ring is re-filled with the pool's neutral element
+    (``-inf`` max / excluded-from-count avg), keeping D1 bit-parity."""
+
+    kind: str
+    halo_in: int
+    count_include_pad: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        from jax import lax as jlax
+
+        from mpi4dl_tpu.parallel.halo import fill_boundary_halo, zero_boundary_halo
+
+        h = self.halo_in
+        if h < 1:
+            raise ValueError("PoolD2 needs halo_in >= 1 (3x3 pad-1 window)")
+        if self.kind == "max":
+            x = fill_boundary_halo(x, h, h, float("-inf"))
+            return nn.max_pool(x, (3, 3), strides=(1, 1), padding="VALID")
+        if self.kind != "avg":
+            raise ValueError(f"unknown pool kind {self.kind!r}")
+        x = zero_boundary_halo(x, h, h)
+        if self.count_include_pad:
+            return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="VALID")
+        ones = zero_boundary_halo(jnp.ones_like(x), h, h)
+        num = jlax.reduce_window(x, 0.0, jlax.add, (1, 3, 3, 1), (1, 1, 1, 1), "valid")
+        den = jlax.reduce_window(ones, 0.0, jlax.add, (1, 3, 3, 1), (1, 1, 1, 1), "valid")
+        return num / den
+
+
+def _pair_(v):
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _crop_halo(x, d: int):
+    if d == 0:
+        return x
+    if d < 0:
+        raise ValueError("cannot crop a negative halo margin")
+    return x[:, d:-d, d:-d, :]
+
+
+# D1 op factory -> (halo consumed by the op's windows, D2 factory).
+# D2 factories: (channels, halo_in, bn_axes, dtype, name) -> module.
+def _d2_conv_1x1(c, h, bn_axes, dtype, name):
+    return ConvBranchD2(
+        channels=c, convs=[(1, 1, 0)], halo_in=h, bottleneck=False,
+        bn_reduce_axes=bn_axes, dtype=dtype, name=name,
+    )
+
+
+def _d2_conv_1x7_7x1(c, h, bn_axes, dtype, name):
+    return ConvBranchD2(
+        channels=c,
+        convs=[((1, 7), (1, 1), (0, 3)), ((7, 1), (1, 1), (3, 0))],
+        halo_in=h, bottleneck=True, bn_reduce_axes=bn_axes, dtype=dtype, name=name,
+    )
+
+
+def _d2_conv_3x3(c, h, bn_axes, dtype, name):
+    return ConvBranchD2(
+        channels=c, convs=[(3, 1, 1)], halo_in=h, bottleneck=True,
+        bn_reduce_axes=bn_axes, dtype=dtype, name=name,
+    )
+
+
+def _d2_max_pool_3x3(c, h, bn_axes, dtype, name):
+    return PoolD2(kind="max", halo_in=h, name=name)
+
+
+def _d2_avg_pool_3x3(c, h, bn_axes, dtype, name):
+    return PoolD2(kind="avg", halo_in=h, count_include_pad=False, name=name)
+
+
+def _d2_none(c, h, bn_axes, dtype, name):
+    return Identity(name=name)
+
+
+D2_OPS = {
+    op_conv_1x1: (0, _d2_conv_1x1),
+    op_conv_1x7_7x1: (3, _d2_conv_1x7_7x1),
+    op_conv_3x3: (1, _d2_conv_3x3),
+    op_max_pool_3x3: (1, _d2_max_pool_3x3),
+    op_avg_pool_3x3: (1, _d2_avg_pool_3x3),
+    op_none: (0, _d2_none),
+}
+
+
+def _plan_state_halos(table) -> list[int]:
+    """Per-state halo widths for one D2 cell: walk the genotype backwards so
+    each state carries the widest halo any consumer chain needs. States 0/1
+    are the cell inputs — their plan entry is the exchange width (the role of
+    the reference's hand-chosen ``s3``/``s4`` halo sizes,
+    ``amoebanet_d2.py:569-632``)."""
+    halos = [0] * (2 + len(table) // 2)
+    for i in reversed(range(0, len(table), 2)):
+        tgt = 2 + i // 2
+        for src, f in table[i : i + 2]:
+            need, _ = D2_OPS[f]
+            halos[src] = max(halos[src], halos[tgt] + need)
+    return halos
+
+
+class AmoebaCellD2(nn.Module):
+    """Fused-halo normal cell (ref ``Cell_D2``, ``amoebanet_d2.py:569-678``):
+    one wide :class:`~mpi4dl_tpu.ops.layers.HaloExchange` per input state
+    (width from :func:`_plan_state_halos`), then the whole genotype runs
+    VALID with per-op crops — 2 exchanges per cell instead of ~8.
+    Parameter structure matches :class:`AmoebaCell` (reduction=False), so the
+    plain model initializes it and D1/D2 are interchangeable mid-zoo."""
+
+    channels_prev_prev: int
+    channels_prev: int
+    channels: int
+    reduction_prev: bool
+    cross_tile_bn: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, input_or_states):
+        if isinstance(input_or_states, (tuple, list)):
+            s1, s2 = input_or_states
+        else:
+            s1 = s2 = input_or_states
+        skip = s1
+
+        bn_axes = _bn_axes(True, self.cross_tile_bn)
+        common = dict(spatial=True, bn_reduce_axes=bn_axes, dtype=self.dtype)
+        s1 = ReluConvBn(features=self.channels, name="reduce1", **common)(s1)
+        if self.reduction_prev:
+            s2 = FactorizedReduce(features=self.channels, name="reduce2", **common)(s2)
+        elif self.channels_prev_prev != self.channels:
+            s2 = ReluConvBn(features=self.channels, name="reduce2", **common)(s2)
+
+        table, concat = NORMAL_OPERATIONS, NORMAL_CONCAT
+        halos = _plan_state_halos(table)
+        states = [
+            HaloExchange(halo_len=halos[0])(s1) if halos[0] else s1,
+            HaloExchange(halo_len=halos[1])(s2) if halos[1] else s2,
+        ]
+        for i in range(0, len(table), 2):
+            tgt_halo = halos[2 + i // 2]
+            pair = []
+            for j, (src, f) in enumerate(table[i : i + 2]):
+                need, d2f = D2_OPS[f]
+                xin = _crop_halo(states[src], halos[src] - (tgt_halo + need))
+                pair.append(
+                    d2f(self.channels, tgt_halo + need, bn_axes, self.dtype, f"op{i + j}")(xin)
+                )
+            states.append(pair[0] + pair[1])
+        out = jnp.concatenate(
+            [_crop_halo(states[i], halos[i]) for i in concat], axis=-1
+        )
+        return out, skip
+
+
 def amoebanetd(
     num_classes: int = 10,
     num_layers: int = 4,
     num_filters: int = 512,
     spatial_cells: int = 0,
     cross_tile_bn: bool = True,
+    halo_d2: bool = False,
     dtype: Any = jnp.float32,
 ) -> list[nn.Module]:
     """AmoebaNet-D as a flat cell list (refs ``amoebanetd``
@@ -376,16 +620,30 @@ def amoebanetd(
     def add_cell(reduction: bool, channels_scale: int):
         state["channels"] *= channels_scale
         spatial = sp()
-        cell = AmoebaCell(
-            channels_prev_prev=state["channels_prev_prev"],
-            channels_prev=state["channels_prev"],
-            channels=state["channels"],
-            reduction=reduction,
-            reduction_prev=state["reduction_prev"],
-            spatial=spatial,
-            cross_tile_bn=cross_tile_bn,
-            dtype=dtype,
-        )
+        if halo_d2 and spatial and not reduction:
+            # D2 fused-halo form for spatial normal cells (ref picks Cell_D2
+            # for exactly these, ``amoebanet_d2.py:896-914``); reduction
+            # cells keep per-op (D1) exchanges — their stride-2 windows need
+            # no halo under the power-of-two tile constraint.
+            cell = AmoebaCellD2(
+                channels_prev_prev=state["channels_prev_prev"],
+                channels_prev=state["channels_prev"],
+                channels=state["channels"],
+                reduction_prev=state["reduction_prev"],
+                cross_tile_bn=cross_tile_bn,
+                dtype=dtype,
+            )
+        else:
+            cell = AmoebaCell(
+                channels_prev_prev=state["channels_prev_prev"],
+                channels_prev=state["channels_prev"],
+                channels=state["channels"],
+                reduction=reduction,
+                reduction_prev=state["reduction_prev"],
+                spatial=spatial,
+                cross_tile_bn=cross_tile_bn,
+                dtype=dtype,
+            )
         concat = REDUCTION_CONCAT if reduction else NORMAL_CONCAT
         state["channels_prev_prev"] = state["channels_prev"]
         state["channels_prev"] = state["channels"] * len(concat)

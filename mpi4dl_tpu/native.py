@@ -1,0 +1,141 @@
+"""ctypes bindings + on-demand build of the native data runtime
+(``native/dataloader.cpp``).
+
+The shared library is compiled once into ``native/build/`` with the system
+``g++`` (no pybind11 in the image — plain ``extern "C"`` + ctypes). All
+entry points degrade gracefully: if the toolchain or the build is
+unavailable, callers fall back to numpy (``available()`` gates the fast
+path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "dataloader.cpp")
+_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libmpi4dl_data.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-fPIC", "-shared", "-pthread", "-std=c++17",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MPI4DL_TPU_NO_NATIVE"):
+            return None
+        have_src = os.path.exists(_SRC)
+        stale = not os.path.exists(_LIB) or (
+            have_src and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if stale and (not have_src or not _build()):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.mpi4dl_fill_uniform.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.mpi4dl_fill_labels.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.c_int,
+        ]
+        lib.mpi4dl_slice_tile.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        lib.mpi4dl_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _nthreads(num_threads: int | None) -> int:
+    if num_threads and num_threads > 0:
+        return num_threads
+    return max(os.cpu_count() or 1, 1)
+
+
+def fill_uniform(shape, seed: int, num_threads: int | None = None) -> np.ndarray:
+    """Deterministic uniform [0,1) float32 array; thread-count independent."""
+    lib = _load()
+    out = np.empty(shape, np.float32)
+    n = out.size
+    if lib is None:
+        rng = np.random.default_rng(seed)
+        out[...] = rng.random(shape, dtype=np.float32)
+        return out
+    lib.mpi4dl_fill_uniform(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, ctypes.c_uint64(seed & (2**64 - 1)), _nthreads(num_threads),
+    )
+    return out
+
+
+def fill_labels(
+    n: int, num_classes: int, seed: int, num_threads: int | None = None
+) -> np.ndarray:
+    lib = _load()
+    out = np.empty((n,), np.int32)
+    if lib is None:
+        rng = np.random.default_rng(seed + 1)
+        out[...] = rng.integers(0, num_classes, size=(n,)).astype(np.int32)
+        return out
+    lib.mpi4dl_fill_labels(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, ctypes.c_uint64(seed & (2**64 - 1)), num_classes, _nthreads(num_threads),
+    )
+    return out
+
+
+def slice_tile(
+    batch: np.ndarray, th: int, tw: int, ti: int, tj: int,
+    num_threads: int | None = None,
+) -> np.ndarray:
+    """Host-side ``split_input`` (ref ``train_spatial.py:241-290``): tile
+    (ti, tj) of a contiguous NHWC float32 batch."""
+    b, h, w, c = batch.shape
+    lib = _load()
+    if lib is None or batch.dtype != np.float32 or not batch.flags.c_contiguous:
+        return np.ascontiguousarray(
+            batch[:, ti * (h // th) : (ti + 1) * (h // th),
+                  tj * (w // tw) : (tj + 1) * (w // tw), :]
+        )
+    out = np.empty((b, h // th, w // tw, c), np.float32)
+    lib.mpi4dl_slice_tile(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        b, h, w, c, th, tw, ti, tj, _nthreads(num_threads),
+    )
+    return out

@@ -60,6 +60,7 @@ def halo_exchange(
     axis_h: str = "tile_h",
     axis_w: str = "tile_w",
     fill_value: float = 0.0,
+    impl: str | None = None,
 ):
     """Return the local tile padded with ``halo_h``/``halo_w`` rows/cols of
     neighbor data (``fill_value`` at the global image boundary).
@@ -76,7 +77,19 @@ def halo_exchange(
     pool matches single-device max pooling exactly (the reference zero-pads
     its distributed max pool, silently diverging from torch's -inf-padded
     ``MaxPool2d`` for negative boundary activations — we fix that).
+
+    ``impl``: ``"xla"`` (ppermute shifts, default) or ``"pallas"`` (one
+    bidirectional remote-DMA kernel per axis —
+    :mod:`mpi4dl_tpu.ops.halo_pallas`); unset → ``MPI4DL_TPU_HALO_IMPL``.
     """
+    from mpi4dl_tpu.ops.halo_pallas import default_impl, halo_exchange_pallas
+
+    if impl is None:
+        impl = default_impl()
+    if impl == "pallas":
+        return halo_exchange_pallas(x, halo_h, halo_w, axis_h, axis_w, fill_value)
+    if impl != "xla":
+        raise ValueError(f"halo impl must be 'xla' or 'pallas', got {impl!r}")
     b, h, w, c = x.shape
 
     def _edge_fill(strip, axis_name, at_index):
@@ -111,16 +124,24 @@ def halo_exchange(
     return x
 
 
-def zero_boundary_halo(x, halo_h: int, halo_w: int, axis_h: str = "tile_h", axis_w: str = "tile_w"):
-    """Zero the halo positions of a halo-carrying tile that lie OUTSIDE the
-    global image.
+def fill_boundary_halo(
+    x,
+    halo_h: int,
+    halo_w: int,
+    value: float = 0.0,
+    axis_h: str = "tile_h",
+    axis_w: str = "tile_w",
+):
+    """Overwrite the halo positions of a halo-carrying tile that lie OUTSIDE
+    the global image with ``value``.
 
     Needed for exact D1<->D2 equivalence: in the D1 (per-conv exchange) form
-    every conv zero-pads *after* the preceding BN+ReLU, while the D2 fused
-    form fetches the halo once up front — by conv time the boundary zeros
-    have been shifted by BN/ReLU. Re-zeroing the outside-image ring right
-    before each VALID conv restores the D1 semantics layer-by-layer (the
-    reference's D2 silently accepts this boundary divergence; we don't).
+    every windowed op pads *after* the preceding BN+ReLU, while the D2 fused
+    form fetches the halo once up front — by op time the boundary pad values
+    have been shifted by BN/ReLU. Re-filling the outside-image ring right
+    before each VALID windowed op restores the D1 semantics layer-by-layer
+    (the reference's D2 silently accepts this boundary divergence; we don't).
+    ``value``: 0 for convs / zero-pad pools, ``-inf`` for max pools.
     """
     b, h, w, c = x.shape
     if halo_h:
@@ -130,7 +151,7 @@ def zero_boundary_halo(x, halo_h: int, halo_w: int, axis_h: str = "tile_h", axis
         outside = ((idx == 0) & (row < halo_h)) | (
             (idx == n - 1) & (row >= h - halo_h)
         )
-        x = jnp.where(outside[None, :, None, None], 0.0, x)
+        x = jnp.where(outside[None, :, None, None], value, x)
     if halo_w:
         idx = lax.axis_index(axis_w)
         n = lax.axis_size(axis_w)
@@ -138,5 +159,10 @@ def zero_boundary_halo(x, halo_h: int, halo_w: int, axis_h: str = "tile_h", axis
         outside = ((idx == 0) & (col < halo_w)) | (
             (idx == n - 1) & (col >= w - halo_w)
         )
-        x = jnp.where(outside[None, None, :, None], 0.0, x)
+        x = jnp.where(outside[None, None, :, None], value, x)
     return x
+
+
+def zero_boundary_halo(x, halo_h: int, halo_w: int, axis_h: str = "tile_h", axis_w: str = "tile_w"):
+    """:func:`fill_boundary_halo` with value 0 (conv ``ZeroPad2d`` parity)."""
+    return fill_boundary_halo(x, halo_h, halo_w, 0.0, axis_h, axis_w)

@@ -172,6 +172,24 @@ class PipelineTrainer:
         if cfg.batch_size % (cfg.parts * cfg.data_parallel):
             raise ValueError("batch_size must divide by parts * data_parallel")
         self.mb_local = cfg.batch_size // cfg.parts // cfg.data_parallel
+        # LOCAL_DP_LP (ref train_spatial.py:809-1028): the reference's join
+        # rank dist.scatters its batch over an SP∪LP group so the LP stages
+        # run data-parallel instead of idle. Here the equivalent is a batch
+        # slice by tile coordinate: each of the th*tw tile devices pipelines
+        # a distinct 1/local_dp of every micro-batch (redundant back-phase
+        # compute becomes data-parallel compute, no communication added —
+        # the "scatter" is choosing a different slice of the already-joined,
+        # replicated activation).
+        self.local_dp = cfg.local_dp
+        if self.local_dp > 1:
+            if self.mb_local % self.local_dp:
+                raise ValueError(
+                    "micro-batch size must divide by local_dp "
+                    f"({self.mb_local} % {self.local_dp})"
+                )
+            self.mb_back = self.mb_local // self.local_dp
+        else:
+            self.mb_back = self.mb_local
         if num_spatial_cells is not None:
             # Explicit front length (e.g. D2 models whose expanded cell list
             # no longer matches D1 stage bounds — the reference mutates
@@ -244,6 +262,15 @@ class PipelineTrainer:
             x, self.front_out_shape = trace(plain_front, x)
         else:
             self.front_out_shape = tuple(x.shape)
+        if self.mb_back != self.mb_local:
+            # LOCAL_DP_LP: back-phase wires carry the per-tile batch slice.
+            x = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    (self.mb_back,) + tuple(s.shape[1:]), s.dtype
+                ),
+                x,
+                is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct),
+            )
         boundary_shapes, out_shape = [], None
         for si, stage in enumerate(plain_back):
             x, shapes = trace(stage, x)
@@ -378,7 +405,7 @@ class PipelineTrainer:
             new_wires = [jnp.zeros_like(w) for w in wires]
             if s < self.S - 1:
                 new_wires[s] = wire_metas[s].flatten(out)
-                logits = jnp.zeros((self.mb_local, self.num_classes), jnp.float32)
+                logits = jnp.zeros((self.mb_back, self.num_classes), jnp.float32)
             else:
                 logits = out.astype(jnp.float32)
             return tuple(new_wires), logits
@@ -399,7 +426,7 @@ class PipelineTrainer:
 
         branches = [self._make_branch(s) for s in range(S)]
         wires0 = tuple(jnp.zeros((m.size,), jnp.float32) for m in self.wire_metas)
-        preds0 = jnp.zeros((parts, self.mb_local, self.num_classes), jnp.float32)
+        preds0 = jnp.zeros((parts, self.mb_back, self.num_classes), jnp.float32)
         perm = [(dev_of(s), dev_of(s + 1)) for s in range(S - 1)]
 
         def tick(carry, t):
@@ -436,11 +463,35 @@ class PipelineTrainer:
         return ce, cc
 
     def _reduce_metrics(self, ce, cc, n_examples_local):
-        """psum-of-contributions normalization (see ``train.Trainer``)."""
-        replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
+        """psum-of-contributions normalization (see ``train.Trainer``).
+
+        With LOCAL_DP_LP the tile devices hold DISTINCT batch slices (no
+        redundancy), so the replica divisor drops to 1 — the ``divide_bs``
+        distinction the reference special-cases at ``comm.py:349-358``."""
+        if self.local_dp > 1:
+            replicas = 1
+        else:
+            replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
         denom = n_examples_local * lax.axis_size(AXIS_DATA) * replicas
         axes = (AXIS_DATA, AXIS_PIPE, AXIS_TILE_H, AXIS_TILE_W)
         return lax.psum(ce / denom, axes), lax.psum(cc / denom, axes)
+
+    def _back_inputs(self, front_out, y):
+        """Select this device's back-phase batch slice: identity without
+        LOCAL_DP_LP; the tile-coordinate slice of every micro-batch with it
+        (the reference's join-rank ``dist.scatter``,
+        ``send_input_spatial_MP_joint_LP_DP`` ``train_spatial.py:809-854``,
+        with the scatter replaced by slicing the already-joined tensor)."""
+        if self.local_dp <= 1:
+            return front_out, y
+        tw = lax.axis_size(AXIS_TILE_W)
+        idx = lax.axis_index(AXIS_TILE_H) * tw + lax.axis_index(AXIS_TILE_W)
+        k = self.mb_back
+
+        def sl(a):
+            return lax.dynamic_slice_in_dim(a, idx * k, k, axis=1)
+
+        return jax.tree.map(sl, front_out), sl(y)
 
     def _local_loss(self, params, x, y):
         """Runs inside shard_map. x: [parts, mb_local, H(/th), W(/tw), C]
@@ -448,6 +499,7 @@ class PipelineTrainer:
         front_flat, stacked_local = params
         flat = stacked_local[0]  # [MAXP] — this device's back-stage params
         front_out = self._front(front_flat, x)
+        front_out, y = self._back_inputs(front_out, y)
         preds, stage_of = self._schedule(flat, front_out, self.mirror)
         ce, cc = self._contributions(preds, y, stage_of)
         return self._reduce_metrics(ce, cc, self.parts * self.mb_local)
@@ -561,6 +613,7 @@ class GemsMasterTrainer(PipelineTrainer):
             xc = jax.tree.map(lambda a: a[c], x)
             yc = y[c]
             front_out = self._front(front_flat, xc)
+            front_out, yc = self._back_inputs(front_out, yc)
             mirror = bool(c % 2)
             preds, stage_of = self._schedule(
                 flipped if mirror else flat, front_out, mirror

@@ -125,4 +125,29 @@ def get_parser() -> argparse.ArgumentParser:
         choices=["bf16", "fp32"],
         help="Compute precision (TPU-native addition; MXU prefers bf16)",
     )
+    parser.add_argument(
+        "--checkpoint-dir",
+        type=str,
+        default=None,
+        help="Save checkpoints here (TPU-native addition; the reference has "
+        "no persistence at all)",
+    )
+    parser.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=100,
+        help="Steps between checkpoints (with --checkpoint-dir)",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        default=False,
+        help="Resume from the newest checkpoint in --checkpoint-dir",
+    )
+    parser.add_argument(
+        "--trace-dir",
+        type=str,
+        default=None,
+        help="Write a jax.profiler trace here (TPU-native addition)",
+    )
     return parser

@@ -1,0 +1,86 @@
+"""Profiling / step timing.
+
+The reference's observability is paired CUDA events around each batch plus
+prints (``benchmark_amoebanet_sp.py:322-367``; SURVEY.md §5.1). The TPU
+equivalents:
+
+- :class:`StepTimer` — host wall-clock per step with ``block_until_ready``
+  (async dispatch means a bare ``time.time()`` measures nothing), tracking
+  the same statistics every reference benchmark prints (per-step seconds,
+  images/sec, mean/median);
+- :func:`trace` — ``jax.profiler`` trace context writing a TensorBoard/XProf
+  trace directory (device timelines, HLO cost, ICI collectives); enabled by
+  path or the ``MPI4DL_TPU_TRACE_DIR`` env var, no-op otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import statistics
+import time
+from typing import Any
+
+
+class StepTimer:
+    """Times steps and accumulates throughput stats.
+
+    Usage::
+
+        timer = StepTimer(batch_size=B, warmup=1)
+        for ... :
+            with timer.step(result_to_block_on_setter) as rec:
+                state, metrics = trainer.train_step(...)
+                rec(metrics)           # anything with .block_until_ready leaves
+        print(timer.summary())
+    """
+
+    def __init__(self, batch_size: int, warmup: int = 1):
+        self.batch_size = batch_size
+        self.warmup = warmup
+        self.times: list[float] = []
+        self._seen = 0
+
+    @contextlib.contextmanager
+    def step(self):
+        import jax
+
+        out: list[Any] = []
+        t0 = time.perf_counter()
+        yield out.append
+        if out:
+            jax.block_until_ready(out[-1])
+        dt = time.perf_counter() - t0
+        self._seen += 1
+        if self._seen > self.warmup:
+            self.times.append(dt)
+
+    @property
+    def images_per_sec(self) -> list[float]:
+        return [self.batch_size / t for t in self.times]
+
+    def summary(self) -> dict:
+        if not self.times:
+            return {"steps": 0}
+        ips = self.images_per_sec
+        return {
+            "steps": len(self.times),
+            "step_time_mean_s": statistics.mean(self.times),
+            "step_time_median_s": statistics.median(self.times),
+            "images_per_sec_mean": statistics.mean(ips),
+            "images_per_sec_median": statistics.median(ips),
+        }
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None = None):
+    """``jax.profiler.trace`` context. ``logdir`` (or ``MPI4DL_TPU_TRACE_DIR``)
+    unset → no-op."""
+    logdir = logdir or os.environ.get("MPI4DL_TPU_TRACE_DIR")
+    if not logdir:
+        yield None
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield logdir

@@ -1,0 +1,84 @@
+"""Checkpoint/resume + profiling subsystem tests (capability additions over
+the reference, which persists nothing — SURVEY.md §5.4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi4dl_tpu.checkpoint import (
+    all_checkpoints,
+    checkpoint_metadata,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from mpi4dl_tpu.config import ParallelConfig
+from mpi4dl_tpu.models.resnet import get_resnet_v1
+from mpi4dl_tpu.profiling import StepTimer
+from mpi4dl_tpu.train import Trainer
+
+
+def _make_trainer():
+    cfg = ParallelConfig(batch_size=2, split_size=1, spatial_size=0, image_size=16)
+    cells = get_resnet_v1(depth=8, pool_kernel=4)
+    return Trainer(cells, num_spatial_cells=0, config=cfg)
+
+
+def _batch(seed):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((2, 16, 16, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(2,)), jnp.int32)
+    return x, y
+
+
+def test_save_restore_resume_parity(tmp_path):
+    """Train 1 step → checkpoint → train 1 more; restoring the checkpoint and
+    redoing step 2 must produce bit-identical parameters to the uninterrupted
+    run."""
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    trainer = _make_trainer()
+    state = trainer.init(jax.random.PRNGKey(0), (2, 16, 16, 3))
+
+    x1, y1 = _batch(1)
+    state, _ = trainer.train_step(state, *trainer.shard_batch(x1, y1))
+    path = save_checkpoint(ckpt, state, metadata={"note": "after-step-1"})
+    assert checkpoint_metadata(path)["note"] == "after-step-1"
+
+    x2, y2 = _batch(2)
+    state, _ = trainer.train_step(state, *trainer.shard_batch(x2, y2))
+    final = jax.device_get(state.params)
+
+    # Resume from the checkpoint into a fresh trainer/state skeleton.
+    trainer2 = _make_trainer()
+    target = trainer2.init(jax.random.PRNGKey(7), (2, 16, 16, 3))  # different init
+    restored = restore_checkpoint(ckpt, target)
+    assert int(restored.step) == 1
+    restored, _ = trainer2.train_step(restored, *trainer2.shard_batch(x2, y2))
+    jax.tree.map(
+        lambda u, v: np.testing.assert_array_equal(np.asarray(u), np.asarray(v)),
+        jax.device_get(restored.params),
+        final,
+    )
+
+
+def test_checkpoint_pruning_and_latest(tmp_path):
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    trainer = _make_trainer()
+    state = trainer.init(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    for s in range(5):
+        save_checkpoint(ckpt, state, step=s, keep=2)
+    steps = [s for s, _ in all_checkpoints(ckpt)]
+    assert steps == [3, 4]
+    assert latest_checkpoint(ckpt).endswith("step_00000004")
+
+
+def test_step_timer_tracks_throughput():
+    timer = StepTimer(batch_size=4, warmup=1)
+    for _ in range(3):
+        with timer.step() as rec:
+            rec(jnp.zeros((2, 2)) + 1)
+    s = timer.summary()
+    assert s["steps"] == 2
+    assert s["images_per_sec_mean"] > 0

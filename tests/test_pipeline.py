@@ -30,9 +30,15 @@ def _golden_from(trainer, state):
     """Single-device golden state sharing the pipeline trainer's init."""
     cell_params = jax.tree.map(np.asarray, trainer.unstack_params(state.params))
     chunks = getattr(trainer, "chunks", 1)  # GEMS runs 2*times chunks
+    # local_dp multiplies the effective micro-batch count: each tile device
+    # pipelines its own 1/local_dp slice (per-slice BN statistics, matching
+    # the reference's per-replica DDP BN under LOCAL_DP_LP).
     _, step = single_device_step(
         trainer.plain_cells,
-        parts=chunks * trainer.config.parts * trainer.config.data_parallel,
+        parts=chunks
+        * trainer.config.parts
+        * trainer.config.data_parallel
+        * trainer.config.local_dp,
     )
     return (
         step,
@@ -140,6 +146,138 @@ def test_sp_lp_pipeline(slice_method, parts_sp, split, depth, parts):
     plain = get_resnet_v1(depth=depth)
     trainer = PipelineTrainer(cells, cfg, plain_cells=plain)
     _run_and_compare(trainer)
+
+
+def _local_dp_golden_step(plain_cells, n_front, parts, ldp, chunks=1):
+    """Golden for LOCAL_DP_LP: front cells see whole micro-batches (BN stats
+    over mb_local), back cells see per-device slices (BN stats over mb_back)
+    — a uniform ``parts`` golden can't express the mixed grouping (the
+    reference has the same semantics: spatial ranks batch-norm full tiles,
+    the scattered LP replicas batch-norm their slice)."""
+    from mpi4dl_tpu.train import (
+        TrainState,
+        correct_count,
+        cross_entropy_sum,
+        make_optimizer,
+    )
+
+    tx = make_optimizer()
+
+    @jax.jit
+    def step(state: TrainState, x, y):
+        def loss_fn(params):
+            b = y.shape[0]
+            groups = chunks * parts
+            xm = x.reshape((groups, b // groups) + tuple(x.shape[1:]))
+            ym = y.reshape((groups, b // groups))
+            ce = jnp.zeros((), jnp.float32)
+            cc = jnp.zeros((), jnp.float32)
+            for g in range(groups):
+                h = xm[g]
+                for cell, p in zip(plain_cells[:n_front], params[:n_front]):
+                    h = cell.apply(p, h)
+                k = h.shape[0] // ldp
+                for d in range(ldp):
+                    hs = h[d * k : (d + 1) * k]
+                    for cell, p in zip(plain_cells[n_front:], params[n_front:]):
+                        hs = cell.apply(p, hs)
+                    ce += cross_entropy_sum(hs, ym[g][d * k : (d + 1) * k])
+                    cc += correct_count(hs, ym[g][d * k : (d + 1) * k]).astype(
+                        jnp.float32
+                    )
+            return ce / b, cc / b
+
+        import optax
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            {"loss": loss, "accuracy": acc},
+        )
+
+    return step
+
+
+def _run_and_compare_local_dp(trainer, steps=2):
+    cfg = trainer.config
+    state = trainer.init(jax.random.PRNGKey(0))
+    cell_params = jax.tree.map(np.asarray, trainer.unstack_params(state.params))
+    chunks = getattr(trainer, "chunks", 1)
+    golden_step = _local_dp_golden_step(
+        trainer.plain_cells,
+        trainer.n_spatial_cells,
+        cfg.parts,
+        cfg.local_dp,
+        chunks=chunks,
+    )
+    golden_state = TrainState(
+        params=cell_params,
+        opt_state=trainer.tx.init(cell_params),
+        step=jnp.zeros((), jnp.int32),
+    )
+    for i in range(steps):
+        x, y = _batch(chunks * cfg.batch_size, cfg.image_size, seed=10 + i)
+        xs, ys = trainer.shard_batch(x, y)
+        state, metrics = trainer.train_step(state, xs, ys)
+        golden_state, golden_metrics = golden_step(golden_state, x, y)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+        )
+    got = jax.tree.map(np.asarray, trainer.unstack_params(state.params))
+    jax.tree.map(
+        lambda u, v: np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), rtol=2e-4, atol=1e-5
+        ),
+        got,
+        golden_state.params,
+    )
+
+
+def test_local_dp_lp_matches_golden():
+    """LOCAL_DP_LP (ref ``train_spatial.py:809-1028``): with ``--local-DP``,
+    the post-join LP stages batch-shard over the 4 tile devices (each
+    pipelines a distinct quarter of every micro-batch) instead of computing
+    redundantly."""
+    cfg = ParallelConfig(
+        batch_size=8,
+        parts=1,
+        split_size=2,
+        spatial_size=1,
+        num_spatial_parts=(4,),
+        slice_method="square",
+        image_size=32,
+        local_dp=4,
+    )
+    n_cells = len(get_resnet_v1(depth=8))
+    n_spatial = PipelineTrainer.spatial_cell_count(n_cells, cfg)
+    cells = get_resnet_v1(depth=8, spatial_cells=n_spatial)
+    plain = get_resnet_v1(depth=8)
+    trainer = PipelineTrainer(cells, cfg, plain_cells=plain)
+    assert trainer.mb_back == 2
+    _run_and_compare_local_dp(trainer)
+
+
+def test_local_dp_lp_with_gems():
+    """LOCAL_DP_LP composes with the GEMS bidirectional schedule."""
+    cfg = ParallelConfig(
+        batch_size=4,
+        parts=1,
+        split_size=2,
+        spatial_size=1,
+        num_spatial_parts=(4,),
+        slice_method="square",
+        image_size=32,
+        local_dp=4,
+        times=1,
+    )
+    n_cells = len(get_resnet_v1(depth=8))
+    n_spatial = GemsMasterTrainer.spatial_cell_count(n_cells, cfg)
+    cells = get_resnet_v1(depth=8, spatial_cells=n_spatial)
+    plain = get_resnet_v1(depth=8)
+    trainer = GemsMasterTrainer(cells, cfg, plain_cells=plain)
+    _run_and_compare_local_dp(trainer)
 
 
 def test_mirror_pipeline_matches_golden():
