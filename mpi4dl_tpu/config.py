@@ -116,25 +116,40 @@ class ParallelConfig:
                 raise ValueError(
                     "num_spatial_parts must have one entry or spatial_size entries"
                 )
-            if len(set(self.num_spatial_parts)) != 1:
-                # Reference parity: "Size of each SP partition should be same"
-                # (train_spatial.py:55-58). Skewed multi-stage SP (4->2 parts)
-                # is a later milestone; until then reject rather than mis-shard.
-                raise ValueError(
-                    "all spatial part counts must be equal "
-                    f"(got {self.num_spatial_parts})"
-                )
+            # Skewed multi-stage SP (ref ``--num-spatial-parts 4,2``: later
+            # spatial stages on fewer ranks, with skewed tile-redistribution
+            # between stages — machinery at train_spatial.py:453-641, though
+            # the reference's own config check rejects non-uniform lists
+            # outright, train_spatial.py:55-58). On a TPU mesh, tiling is
+            # decoupled from device count: running every SP stage on the
+            # finest grid produces identical numerics (halo-exchanged fine
+            # tiles compute the same global convolution as coarser tiles)
+            # with no idle devices and no redistribution collective. So we
+            # accept decreasing lists — a superset of the reference — and
+            # execute on the max-parts grid; increasing lists stay rejected.
+            prev = None
+            for p in self.num_spatial_parts:
+                if prev is not None and p > prev:
+                    # Non-increasing powers of two always divide each other,
+                    # so the reference's coarsening re-tile is well defined.
+                    raise ValueError(
+                        "spatial part counts must be non-increasing "
+                        f"(got {self.num_spatial_parts})"
+                    )
+                prev = p
             for p in self.num_spatial_parts:
                 if not is_power_two(p):
                     raise ValueError("each spatial part count must be a power of two")
-                th, tw = tile_grid(p, self.slice_method)
-                if self.image_size % th or self.image_size % tw:
-                    raise ValueError("image size must divide evenly into tiles")
-                if not (
-                    is_power_two(self.image_size // th)
-                    and is_power_two(self.image_size // tw)
-                ):
-                    raise ValueError("per-partition image size must be a power of two")
+            # Geometry checks apply to the executed (max-parts) grid; smaller
+            # later-stage entries only describe the reference's rank mapping.
+            th, tw = tile_grid(self.spatial_parts, self.slice_method)
+            if self.image_size % th or self.image_size % tw:
+                raise ValueError("image size must divide evenly into tiles")
+            if not (
+                is_power_two(self.image_size // th)
+                and is_power_two(self.image_size // tw)
+            ):
+                raise ValueError("per-partition image size must be a power of two")
         if self.balance is not None:
             if len(self.balance) != self.split_size:
                 raise ValueError("balance list length must equal split_size")
@@ -145,7 +160,7 @@ class ParallelConfig:
             # the post-join LP stages batch-shard over the spatial devices.
             if not self.spatial_size:
                 raise ValueError("local_dp > 1 requires a spatial front")
-            th, tw = tile_grid(max(self.num_spatial_parts), self.slice_method)
+            th, tw = tile_grid(self.spatial_parts, self.slice_method)
             if self.local_dp != th * tw:
                 raise ValueError(
                     f"local_dp must equal the spatial device count {th * tw} "
@@ -155,7 +170,8 @@ class ParallelConfig:
     # -- derived geometry ---------------------------------------------------
     @property
     def spatial_parts(self) -> int:
-        """Tile-device count (max over SP stages; uniform in round 1)."""
+        """Tile-device count: max over SP stages (skewed lists execute every
+        stage on this finest grid — see validate())."""
         return max(self.num_spatial_parts) if self.spatial_size else 1
 
     @property

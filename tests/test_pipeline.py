@@ -280,6 +280,44 @@ def test_local_dp_lp_with_gems():
     _run_and_compare_local_dp(trainer)
 
 
+def test_skewed_multistage_sp_matches_golden():
+    """Skewed multi-stage SP (ref ``--num-spatial-parts 4,2``,
+    ``train_spatial.py:453-641``): two spatial stages with decreasing part
+    counts. TPU-native execution keeps the finest (4-tile) grid for both
+    stages — numerically identical to the reference's coarser re-tiling,
+    whose only purpose is GPU rank mapping — so the golden comparison proves
+    the capability, not just the flag parsing."""
+    cfg = ParallelConfig(
+        batch_size=2,
+        parts=2,
+        split_size=3,
+        spatial_size=2,
+        num_spatial_parts=(4, 2),
+        slice_method="square",
+        image_size=32,
+    )
+    n_cells = len(get_resnet_v1(depth=14))
+    n_spatial = PipelineTrainer.spatial_cell_count(n_cells, cfg)
+    cells = get_resnet_v1(depth=14, spatial_cells=n_spatial)
+    plain = get_resnet_v1(depth=14)
+    trainer = PipelineTrainer(cells, cfg, plain_cells=plain)
+    _run_and_compare(trainer)
+
+
+def test_skewed_sp_validation():
+    """Increasing part lists are rejected; decreasing ones are accepted and
+    run on the finest grid (a superset of the reference, whose config check
+    rejects all non-uniform lists, train_spatial.py:55-58, even though its
+    skewed-transition machinery exists at train_spatial.py:453-641)."""
+    base = dict(
+        batch_size=2, parts=1, split_size=3, spatial_size=2,
+        slice_method="square", image_size=32,
+    )
+    with pytest.raises(ValueError):
+        ParallelConfig(num_spatial_parts=(2, 4), **base)
+    ParallelConfig(num_spatial_parts=(4, 2), **base)  # valid
+
+
 def test_mirror_pipeline_matches_golden():
     """GEMS_INVERSE placement: stage s on pipe device S-1-s, wire flow
     reversed (ref ``mp_pipeline.py:238-248``) — must be numerically identical
