@@ -1,0 +1,50 @@
+"""Shared ground-truth check for the halo benchmark scripts.
+
+One implementation of the padded-tile equality loop (vs the reference, which
+re-implements its ``np.pad`` harness in each of its four halo scripts,
+``benchmark_sp_halo_exchange.py:417-584`` et al.).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def validate_padded_tiles(
+    got_pad: np.ndarray,
+    x: np.ndarray,
+    th: int,
+    tw: int,
+    halo_h: int,
+    halo_w: int,
+    label: str = "recv",
+) -> int:
+    """Check every tile's FULL halo-carrying padded tile against the
+    ``np.pad`` ground truth of the global image (all four exchange
+    directions + boundary fill).
+
+    got_pad: the shard_map output whose per-device value is the whole padded
+        tile — globally ``[B, th*(t_h+2*halo_h), tw*(t_w+2*halo_w), C]``.
+    x: the global input image ``[B, H, W, C]``.
+    Returns the number of mismatching tiles (0 = pass), printing per-tile
+    diagnostics to stderr.
+    """
+    x = np.asarray(x)
+    got_pad = np.asarray(got_pad)
+    s_h, s_w = x.shape[1], x.shape[2]
+    t_h, t_w = s_h // th, s_w // tw
+    p_h, p_w = t_h + 2 * halo_h, t_w + 2 * halo_w
+    ref_pad = np.pad(x, ((0, 0), (halo_h, halo_h), (halo_w, halo_w), (0, 0)))
+    bad = 0
+    for i in range(th):
+        for j in range(tw):
+            # Tile (i,j)'s padded tile == the (t+2*halo)-window of the
+            # globally padded image anchored at the tile origin.
+            want = ref_pad[:, i * t_h : i * t_h + p_h, j * t_w : j * t_w + p_w, :]
+            have = got_pad[:, i * p_h : (i + 1) * p_h, j * p_w : (j + 1) * p_w, :]
+            if not np.array_equal(want, have):
+                bad += 1
+                print(f"{label} check tile ({i},{j}): MISMATCH", file=sys.stderr)
+    return bad
