@@ -7,11 +7,13 @@ with per-step timing, print images/sec mean/median at exit
 (ref timing: ``benchmark_amoebanet_sp.py:322-367`` — CUDA events there,
 host-side timing with ``block_until_ready`` here; both wall-clock).
 
-All benchmarks run single-process SPMD over however many devices JAX sees:
-real TPUs, or CPU simulation via
-``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
-(no ``mpirun_rsh``; the launcher contract collapses into JAX device
-discovery).
+Every benchmark is one SPMD program over however many devices JAX sees:
+one real TPU chip, a CPU simulation
+(``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``),
+or a multi-host pod — ``build_config`` joins the distributed world
+(``multihost.initialize_distributed``), ``make_trainer`` builds a DCN-aware
+mesh, and ``run_training`` feeds each host only its data shard. There is no
+``mpirun_rsh`` contract; single-host launch needs no launcher at all.
 """
 
 from __future__ import annotations
@@ -33,7 +35,11 @@ def build_config(args, spatial: bool, num_cells: int | None = None):
     import jax.numpy as jnp
 
     from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.parallel import multihost
 
+    # Join the multi-host world if one is configured (no-op single-process;
+    # the reference's dist.init_process_group moment, comm.py:154-159).
+    multihost.initialize_distributed()
     return ParallelConfig(
         batch_size=args.batch_size,
         parts=args.parts,
@@ -109,6 +115,7 @@ def build_amoebanet(args, cfg, spatial_cells=0):
 def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=None):
     import jax
 
+    from mpi4dl_tpu.parallel import multihost
     from mpi4dl_tpu.parallel.pipeline import GemsMasterTrainer, PipelineTrainer
     from mpi4dl_tpu.train import Trainer
 
@@ -119,6 +126,9 @@ def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=No
             f"have {len(jax.devices())}. For CPU simulation set "
             f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count={n_dev}"
         )
+    # DCN-aware placement on multi-slice systems; identical to
+    # cfg.make_mesh() on one slice (multihost.make_multihost_mesh docs).
+    mesh = multihost.make_multihost_mesh(cfg)
     override = n_spatial  # None → trainers derive from config stage bounds
     if n_spatial is None:
         n_spatial = (
@@ -129,7 +139,8 @@ def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=No
     if gems:
         return (
             GemsMasterTrainer(
-                cells, cfg, plain_cells=plain_cells, num_spatial_cells=override
+                cells, cfg, plain_cells=plain_cells, num_spatial_cells=override,
+                mesh=mesh,
             ),
             n_spatial,
         )
@@ -140,12 +151,14 @@ def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=No
                 num_spatial_cells=n_spatial,
                 config=cfg,
                 plain_cells=plain_cells,
+                mesh=mesh,
             ),
             n_spatial,
         )
     return (
         PipelineTrainer(
-            cells, cfg, plain_cells=plain_cells, num_spatial_cells=override
+            cells, cfg, plain_cells=plain_cells, num_spatial_cells=override,
+            mesh=mesh,
         ),
         n_spatial,
     )
@@ -165,7 +178,21 @@ def run_training(args, trainer, tag: str):
     cfg = trainer.config
     chunks = getattr(trainer, "chunks", 1)
     global_batch = chunks * cfg.batch_size
-    ds = get_dataset(args, global_batch, cfg.num_classes)
+    # Multi-process: every host loads ONLY its share of the global batch
+    # (the data axis may span hosts; shard_batch assembles the global array
+    # via make_array_from_process_local_data — multihost.put_global). The
+    # reference instead loads the global batch on every rank and slices
+    # (benchmark_amoebanet_sp.py:329-340).
+    if jax.process_count() > 1:
+        from mpi4dl_tpu.parallel.multihost import data_shard, local_batch_size
+
+        host_batch = local_batch_size(trainer.mesh, global_batch)
+        shard_id, num_shards = data_shard(trainer.mesh)
+    else:
+        host_batch, shard_id, num_shards = global_batch, 0, 1
+    ds = get_dataset(
+        args, host_batch, cfg.num_classes, shard_id=shard_id, num_shards=num_shards
+    )
 
     if hasattr(trainer, "init_params") or not hasattr(trainer, "n_spatial"):
         state = trainer.init(jax.random.PRNGKey(0))

@@ -105,7 +105,7 @@ class SyntheticImages:
             stop.set()  # runs on generator close/GC too — unblocks producer
 
 
-def _torchvision_loader(kind, args, batch_size):
+def _torchvision_loader(kind, args, batch_size, shard_id=0, num_shards=1):
     import torch
     import torchvision
     from torchvision import transforms
@@ -122,10 +122,25 @@ def _torchvision_loader(kind, args, batch_size):
         ds = torchvision.datasets.CIFAR10(
             root=args.datapath, train=True, transform=transform, download=False
         )
+    sampler = None
+    if num_shards > 1:
+        # Multi-host: each data shard reads a disjoint subset (hosts at the
+        # same data coordinate pass the same shard_id and stay identical).
+        sampler = torch.utils.data.distributed.DistributedSampler(
+            ds,
+            num_replicas=num_shards,
+            rank=shard_id,
+            shuffle=False,
+            # Without drop_last the sampler pads by wrapping, handing the
+            # same leading samples to several shards — shards must stay
+            # disjoint.
+            drop_last=True,
+        )
     loader = torch.utils.data.DataLoader(
         ds,
         batch_size=batch_size,
         shuffle=False,
+        sampler=sampler,
         num_workers=args.num_workers,
         drop_last=True,
     )
@@ -148,15 +163,22 @@ def _torchvision_loader(kind, args, batch_size):
     return _Wrap()
 
 
-def get_dataset(args, batch_size, num_classes):
-    """Dataset iterable of (x NHWC f32, y i32) host batches."""
+def get_dataset(args, batch_size, num_classes, shard_id=0, num_shards=1):
+    """Dataset iterable of (x NHWC f32, y i32) host batches.
+
+    ``shard_id``/``num_shards`` shard the stream for multi-process runs
+    along the batch axis (``run_training`` passes ``multihost.data_shard``,
+    which keeps model-parallel co-hosts — same data coordinates — on the
+    SAME shard)."""
     if args.app in (1, 2):
         kind = "imagefolder" if args.app == 1 else "cifar"
         try:
-            return _torchvision_loader(kind, args, batch_size)
+            return _torchvision_loader(
+                kind, args, batch_size, shard_id=shard_id, num_shards=num_shards
+            )
         except Exception as e:  # no torchvision / no data on this machine
             print(
                 f"app={args.app} dataset unavailable ({e}); using synthetic",
                 file=sys.stderr,
             )
-    return SyntheticImages(batch_size, args.image_size, num_classes)
+    return SyntheticImages(batch_size, args.image_size, num_classes, seed=shard_id)

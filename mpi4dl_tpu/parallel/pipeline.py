@@ -542,13 +542,15 @@ class PipelineTrainer:
 
     def shard_batch(self, x, y):
         """[B, H, W, C] → micro-batched [parts, mb, H, W, C] placed on the
-        mesh (batch over ``data``, H/W over tile axes for spatial configs)."""
+        mesh (batch over ``data``, H/W over tile axes for spatial configs).
+        Multi-process, (x, y) are this host's local batch shard
+        (:func:`mpi4dl_tpu.parallel.multihost.put_global`)."""
+        from mpi4dl_tpu.parallel.multihost import put_global
+
         b = x.shape[0]
         x = x.reshape((self.parts, b // self.parts) + tuple(x.shape[1:]))
         y = y.reshape((self.parts, b // self.parts))
-        xs = jax.device_put(x, NamedSharding(self.mesh, self.x_spec))
-        ys = jax.device_put(y, NamedSharding(self.mesh, self.y_spec))
-        return xs, ys
+        return put_global(self.mesh, (self.x_spec, self.y_spec), x, y)
 
 
 class GemsMasterTrainer(PipelineTrainer):
@@ -625,7 +627,10 @@ class GemsMasterTrainer(PipelineTrainer):
         return self._reduce_metrics(ce_tot, cc_tot, n_local)
 
     def shard_batch(self, x, y):
-        """[2*times*B, H, W, C] → [2*times, parts, mb, H, W, C] on the mesh."""
+        """[2*times*B, H, W, C] → [2*times, parts, mb, H, W, C] on the mesh.
+        Multi-process, (x, y) are this host's local batch shard."""
+        from mpi4dl_tpu.parallel.multihost import put_global
+
         b = x.shape[0]
         if b % self.chunks:
             raise ValueError(
@@ -634,6 +639,4 @@ class GemsMasterTrainer(PipelineTrainer):
         per = b // self.chunks
         x = x.reshape((self.chunks, self.parts, per // self.parts) + tuple(x.shape[1:]))
         y = y.reshape((self.chunks, self.parts, per // self.parts))
-        xs = jax.device_put(x, NamedSharding(self.mesh, self.x_spec))
-        ys = jax.device_put(y, NamedSharding(self.mesh, self.y_spec))
-        return xs, ys
+        return put_global(self.mesh, (self.x_spec, self.y_spec), x, y)

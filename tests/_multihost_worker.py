@@ -1,0 +1,111 @@
+"""Worker process for the real multi-process integration test
+(``test_multihost_spawn.py``). Two of these form a 2-host world (2 CPU
+devices each, Gloo collectives over localhost) and each validates real
+training steps against an in-process single-device golden model.
+
+Golden-comparison note: BatchNorm statistics are computed per data-shard in
+the distributed run, so the golden run uses ``parts`` microbatching with
+microbatch contents equal to the distributed per-device shards — then both
+compute identical BN groups and the losses must match exactly.
+
+Usage: python _multihost_worker.py <process_id> <coordinator_port>
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+pid, port = int(sys.argv[1]), int(sys.argv[2])
+
+from mpi4dl_tpu.parallel import multihost  # noqa: E402  (before device use)
+
+# Exercises the wrapper itself: explicit args configure the world, so any
+# init failure must propagate (never silently fall back to single-host).
+multihost.initialize_distributed(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mpi4dl_tpu.config import ParallelConfig  # noqa: E402
+from mpi4dl_tpu.models.resnet import get_resnet_v1  # noqa: E402
+from mpi4dl_tpu.parallel.partition import init_cells  # noqa: E402
+from mpi4dl_tpu.parallel.pipeline import PipelineTrainer  # noqa: E402
+from mpi4dl_tpu.train import (  # noqa: E402
+    Trainer,
+    TrainState,
+    make_optimizer,
+    single_device_step,
+)
+
+# Deterministic global batch, identical on both hosts; each host feeds only
+# its local shard.
+rng = np.random.default_rng(0)
+GB = 8
+x = rng.standard_normal((GB, 32, 32, 3)).astype(np.float32)
+y = rng.integers(0, 10, size=(GB,)).astype(np.int32)
+cells = get_resnet_v1(depth=8)
+
+
+def golden_loss(parts):
+    """Single-device step with per-microbatch BN groups of size GB/parts."""
+    _, step = single_device_step(cells, parts=parts)
+    params = init_cells(cells, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    tx = make_optimizer()
+    st = TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )
+    _, metrics = step(st, jnp.asarray(x), jnp.asarray(y))
+    return float(metrics["loss"])
+
+
+# -- case 1: DP over both hosts (data axis crosses processes) ---------------
+# 4 data coords, per-device batch 2; coords {2p, 2p+1} live on host p, so
+# host p's local shard is the contiguous x[4p:4p+4] and the assembled global
+# batch is in canonical order. Golden = parts=4 (BN groups of 2, identical).
+cfg = ParallelConfig(
+    batch_size=GB, split_size=1, spatial_size=0, data_parallel=4, image_size=32
+)
+mesh = multihost.make_multihost_mesh(cfg)
+trainer = Trainer(cells, num_spatial_cells=0, config=cfg, mesh=mesh)
+assert multihost.local_batch_size(mesh, GB) == GB // 2
+assert multihost.data_shard(mesh) == (pid, 2), multihost.data_shard(mesh)
+state = trainer.init(jax.random.PRNGKey(0), x.shape)
+lo = pid * (GB // 2)
+xs, ys = trainer.shard_batch(x[lo : lo + GB // 2], y[lo : lo + GB // 2])
+assert xs.shape == (GB, 32, 32, 3), xs.shape  # global batch assembled
+_, metrics = trainer.train_step(state, xs, ys)
+got = float(metrics["loss"])
+want = golden_loss(parts=4)
+assert abs(got - want) < 1e-4, (got, want)
+print(f"proc {pid}: DP case OK loss={got:.6f}", flush=True)
+
+# -- case 2: DP x pipeline (pipe axis inside each host) ---------------------
+# Global microbatch m must be x[4m:4m+4]; within it, data coord d holds rows
+# [2d:2d+2]. Host p (= data coord p here) therefore feeds, for each of its
+# local parts m: x[4m+2p : 4m+2p+2]. BN groups of 2 → golden parts=4.
+cfg2 = ParallelConfig(
+    batch_size=GB, parts=2, split_size=2, spatial_size=0, data_parallel=2,
+    image_size=32,
+)
+mesh2 = multihost.make_multihost_mesh(cfg2)
+t2 = PipelineTrainer(cells, cfg2, mesh=mesh2)
+assert multihost.local_batch_size(mesh2, GB) == GB // 2
+local_rows = np.concatenate([x[4 * m + 2 * pid : 4 * m + 2 * pid + 2] for m in (0, 1)])
+local_labels = np.concatenate(
+    [y[4 * m + 2 * pid : 4 * m + 2 * pid + 2] for m in (0, 1)]
+)
+state2 = t2.init(jax.random.PRNGKey(0))
+xs2, ys2 = t2.shard_batch(local_rows, local_labels)
+_, m2 = t2.train_step(state2, xs2, ys2)
+got2 = float(m2["loss"])
+want2 = golden_loss(parts=4)
+assert abs(got2 - want2) < 1e-4, (got2, want2)
+print(f"proc {pid}: DPxPP case OK loss={got2:.6f}", flush=True)
+print(f"proc {pid}: ALL OK", flush=True)
