@@ -129,3 +129,63 @@ def test_pure_dp_no_spatial():
         float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
     )
     _assert_tree_close(state.params, golden_state.params, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("remat", ["cell", "sqrt", "scan"])
+def test_remat_policies_match_golden(remat):
+    """Every remat policy is a pure scheduling choice: losses, metrics, and
+    updated parameters must be identical to the no-remat golden step. "scan"
+    additionally rewrites repeated cells into a stacked-parameter lax.scan
+    with compact [B, H, W*C] carries — still bit-equivalent."""
+    cells = get_resnet_v1(depth=20)  # 3 stages x 3 repeated blocks → scannable runs
+    cfg = ParallelConfig(batch_size=4, split_size=1, spatial_size=0, image_size=32)
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat=remat)
+    state = trainer.init(jax.random.PRNGKey(3), (4, 32, 32, 3))
+    _, golden_step = single_device_step(cells)
+    gp = jax.tree.map(jnp.copy, state.params)
+    golden_state = TrainState(
+        params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+    )
+    x, y = _batch(b=4, size=32)
+    for seed in (1, 2):
+        xs, ys = trainer.shard_batch(x, y)
+        state, metrics = trainer.train_step(state, xs, ys)
+        golden_state, golden_metrics = golden_step(golden_state, x, y)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+        )
+        x, y = _batch(b=4, size=32, seed=seed + 20)
+    _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
+
+
+def test_scan_remat_spatial_matches_golden():
+    """The "scan" policy composes with a spatial front: runs never span the
+    SP→LP join and spatial (halo-exchanging) repeated cells scan inside
+    shard_map."""
+    cfg = ParallelConfig(
+        batch_size=4,
+        split_size=1,
+        spatial_size=1,
+        num_spatial_parts=(4,),
+        slice_method="square",
+        image_size=32,
+    )
+    spatial = get_resnet_v1(depth=14, spatial_cells=5, cross_tile_bn=True)
+    plain = get_resnet_v1(depth=14, spatial_cells=0)
+    trainer = Trainer(
+        spatial, num_spatial_cells=5, config=cfg, plain_cells=plain, remat="scan"
+    )
+    state = trainer.init(jax.random.PRNGKey(4), (4, 32, 32, 3))
+    _, golden_step = single_device_step(plain)
+    gp = jax.tree.map(jnp.copy, state.params)
+    golden_state = TrainState(
+        params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+    )
+    x, y = _batch(b=4, size=32)
+    xs, ys = trainer.shard_batch(x, y)
+    state, metrics = trainer.train_step(state, xs, ys)
+    golden_state, golden_metrics = golden_step(golden_state, x, y)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+    )
+    _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
