@@ -1,11 +1,13 @@
 """ctypes bindings + on-demand build of the native data runtime
-(``native/dataloader.cpp``).
+(``mpi4dl_tpu/native_src/dataloader.cpp`` — shipped as package data, so
+installed copies keep the fast path).
 
-The shared library is compiled once into ``native/build/`` with the system
-``g++`` (no pybind11 in the image — plain ``extern "C"`` + ctypes). All
-entry points degrade gracefully: if the toolchain or the build is
-unavailable, callers fall back to numpy (``available()`` gates the fast
-path).
+The shared library is compiled once with the system ``g++`` (no pybind11 in
+the image — plain ``extern "C"`` + ctypes), into a ``build/`` dir next to
+the source when writable, else ``~/.cache/mpi4dl_tpu`` (installed packages
+may live on a read-only filesystem). All entry points degrade gracefully:
+if the toolchain or the build is unavailable, callers fall back to numpy
+(``available()`` gates the fast path).
 """
 
 from __future__ import annotations
@@ -17,9 +19,24 @@ import threading
 
 import numpy as np
 
-_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_SRC = os.path.join(_ROOT, "native", "dataloader.cpp")
-_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "native_src", "dataloader.cpp")
+
+
+def _pick_build_dir() -> str:
+    explicit = os.environ.get("MPI4DL_TPU_NATIVE_BUILD")
+    if explicit:
+        return explicit
+    preferred = os.path.join(os.path.dirname(_SRC), "build")
+    probe_root = os.path.dirname(preferred)
+    if os.access(probe_root, os.W_OK):
+        return preferred
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "mpi4dl_tpu", "native_build"
+    )
+
+
+_BUILD_DIR = _pick_build_dir()
 _LIB = os.path.join(_BUILD_DIR, "libmpi4dl_data.so")
 
 _lock = threading.Lock()
