@@ -137,6 +137,17 @@ def make_trainer(args, cfg, cells, plain_cells, gems: bool = False, n_spatial=No
             else 0
         )
     if gems:
+        if getattr(args, "enable_master_comm_opt", False):
+            # Accepted for CLI parity (ref --enable-master-comm-opt,
+            # train_spatial_master.py:229-455). The optimization it selects
+            # there — pairwise flat param/grad P2P instead of ordered
+            # allreduces — is the DEFAULT and only path here: the mirror
+            # direction's params arrive by one pipe-axis ppermute and its
+            # AD transpose is the paired grad reduce. Nothing to switch.
+            print(
+                "note: --enable-master-comm-opt is implied on TPU "
+                "(mirror ppermute == the comm-opt pairwise exchange)"
+            )
         return (
             GemsMasterTrainer(
                 cells, cfg, plain_cells=plain_cells, num_spatial_cells=override,

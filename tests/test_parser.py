@@ -1,0 +1,65 @@
+"""CLI parser parity (ref ``torchgems/parser.py:21-143``): the reference's
+benchmark invocations must parse unchanged, including csv flags and the
+TPU-era additions."""
+
+from mpi4dl_tpu.parser import get_parser
+
+
+def test_reference_invocation_parses():
+    # Flags straight from the reference README's SP example.
+    args = get_parser().parse_args(
+        [
+            "--batch-size", "2",
+            "--parts", "4",
+            "--split-size", "3",
+            "--spatial-size", "1",
+            "--num-spatial-parts", "4",
+            "--slice-method", "square",
+            "--image-size", "1024",
+            "--num-epochs", "1",
+            "--halo-D2",
+            "--fused-layers", "2",
+            "--local-DP", "4",
+            "--times", "2",
+            "--app", "3",
+            "--enable-master-comm-opt",
+            "--num-workers", "2",
+            "--verbose",
+        ]
+    )
+    assert args.batch_size == 2
+    assert args.parts == 4
+    assert args.split_size == 3
+    assert args.spatial_size == 1
+    assert args.num_spatial_parts == "4"
+    assert args.slice_method == "square"
+    assert args.halo_d2 is True
+    assert args.fused_layers == 2
+    assert args.local_DP == 4
+    assert args.times == 2
+    assert args.app == 3
+    assert args.enable_master_comm_opt is True
+    assert args.num_workers == 2
+    assert args.verbose is True
+
+
+def test_csv_parsing():
+    import sys, os
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "benchmarks")
+    )
+    from common import parse_csv_ints
+
+    assert parse_csv_ints("4,2") == [4, 2]
+    assert parse_csv_ints("8") == [8]
+    assert parse_csv_ints(None) is None
+
+
+def test_tpu_additions_defaults():
+    args = get_parser().parse_args([])
+    assert args.precision == "bf16"
+    assert args.max_steps is None
+    assert args.checkpoint_dir is None
+    assert args.resume is False
+    assert args.trace_dir is None
