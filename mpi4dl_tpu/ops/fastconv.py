@@ -1,0 +1,263 @@
+"""MXU-packed convolution: same math, lane-filling output channels.
+
+Motivation (measured on the bench TPU, see ``docs/PERF.md``): the MXU's
+effective rate is gated by the matmul's N dimension (output channels for a
+conv). The reference models' CIFAR-style ResNet/AmoebaNet trunks carry 16-64
+channels at very high resolution, so their convs run a [M, K] x [K, 16]
+matmul — ~2.5 TF/s on hardware whose [M, K] x [K, 128] rate is ~25 TF/s.
+The image is huge and the channel count tiny: exactly the wrong aspect
+ratio for a 128x128 systolic array.
+
+The fix is a layout identity, not an approximation. A stride-1 ``kh x kw``
+conv producing ``O`` channels equals a stride-``(fh, fw)`` conv with a
+``(kh+fh-1) x (kw+fw-1)`` *scattered* kernel producing ``fh*fw*O``
+channels — output channel group (py, px) holds the original kernel shifted
+by (py, px) and computes the original output subpixel (py, px) of each
+``fh x fw`` output block — followed by a depth-to-space reshuffle. Zero
+taps add exact zeros to the accumulator, so the result is the same sum of
+the same products (mod f32 accumulation order). FLOPs inflate by
+``(kh+fh-1)(kw+fw-1) / (kh kw)`` while the MXU N-dimension grows
+``fh*fw``-fold — a large net win for small ``O`` (measured ~2x+ for 3x3 at
+16-64 channels). 1x1 convs never profit: inflation is exactly ``fh*fw``,
+cancelling the N gain — they stay on the stock path.
+
+The custom VJP (stride-1 convs only; strided convs take the stock XLA path
+end to end) packs the data gradient too — itself a small-N stride-1 conv of
+``dy`` with the flipped/io-swapped kernel — and computes the weight
+gradient with the classic transposed-wgrad conv (x as "CHWN", dy as the
+kernel), the same canonical form XLA's own AD emits.
+
+Used by :class:`mpi4dl_tpu.ops.layers.Conv2d` via :class:`FastConv`;
+selection is automatic (TPU + profitable shapes) and can be forced or
+disabled with ``MPI4DL_TPU_CONV_IMPL`` = ``packed`` | ``xla`` | ``auto``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+# Pack to at least this many output channels (the MXU lane count; measured
+# rates keep improving up to ~128 lanes — see docs/PERF.md).
+_TARGET_N = 128
+# Accept at most this much FLOP inflation from kernel scattering.
+_MAX_INFLATE = 3.5
+# Candidate per-axis output-block factors.
+_FACTORS = (1, 2, 4, 8)
+
+
+def conv_impl() -> str:
+    """Global conv implementation selector: "auto" (default), "packed",
+    or "xla" (``MPI4DL_TPU_CONV_IMPL``). Unknown values fail loudly."""
+    impl = os.environ.get("MPI4DL_TPU_CONV_IMPL", "auto")
+    if impl not in ("auto", "packed", "xla"):
+        raise ValueError(
+            f"MPI4DL_TPU_CONV_IMPL must be auto|packed|xla, got {impl!r}"
+        )
+    return impl
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - device probing never fatal
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def pack_factors(
+    kh: int, kw: int, c_out: int, h_out: int, w_out: int
+) -> tuple[int, int]:
+    """Choose (fh, fw) output-block factors for a stride-1 conv; (1, 1)
+    means "don't pack".
+
+    Profitability model from the measured MXU rate curve: rate grows
+    ~linearly in N up to ``_TARGET_N`` lanes, while scattering inflates
+    FLOPs by ``(kh+fh-1)(kw+fw-1)/(kh kw)``. Maximize
+    ``min(N', TARGET)/inflation``; require a >1.3x modeled win.
+    """
+    if (kh == 1 and kw == 1) or c_out >= _TARGET_N:
+        return (1, 1)
+
+    def score(fh: int, fw: int) -> float:
+        inflation = ((kh + fh - 1) * (kw + fw - 1)) / (kh * kw)
+        if inflation > _MAX_INFLATE:
+            return 0.0
+        gain = min(fh * fw * c_out, _TARGET_N) / min(c_out, _TARGET_N)
+        return gain / inflation
+
+    best, best_s = (1, 1), 1.3
+    for fh in _FACTORS:
+        if h_out % fh:
+            continue
+        for fw in _FACTORS:
+            if fh * fw == 1 or w_out % fw:
+                continue
+            s = score(fh, fw)
+            if s > best_s:
+                best, best_s = (fh, fw), s
+    return best
+
+
+def _scatter_kernel(w, fh: int, fw: int):
+    """[kh, kw, C, O] -> [kh+fh-1, kw+fw-1, C, fh*fw*O] scattered kernel.
+
+    Built by padding + stacking (kernel-sized, fuses under jit)."""
+    kh, kw, c, o = w.shape
+    blocks = [
+        jnp.pad(w, ((py, fh - 1 - py), (px, fw - 1 - px), (0, 0), (0, 0)))
+        for py in range(fh)
+        for px in range(fw)
+    ]
+    wp = jnp.stack(blocks, axis=3)  # [kh', kw', C, fh*fw, O]
+    return wp.reshape(kh + fh - 1, kw + fw - 1, c, fh * fw * o)
+
+
+def _depth_to_space(y, fh: int, fw: int):
+    """[B, H, W, fh*fw*O] -> [B, H*fh, W*fw, O]."""
+    b, h, w, c = y.shape
+    o = c // (fh * fw)
+    y = y.reshape(b, h, w, fh, fw, o)
+    y = y.transpose(0, 1, 3, 2, 4, 5)
+    return y.reshape(b, h * fh, w * fw, o)
+
+
+def _conv_packed(x, w, padding, fh: int, fw: int):
+    """Stride-1 conv with explicit padding pairs, packed formulation."""
+    (ph0, ph1), (pw0, pw1) = padding
+    if ph0 or ph1 or pw0 or pw1:
+        x = jnp.pad(x, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+    wp = _scatter_kernel(w, fh, fw)
+    y = lax.conv_general_dilated(
+        x, wp, (fh, fw), "VALID", dimension_numbers=_DIMNUMS
+    )
+    return _depth_to_space(y, fh, fw)
+
+
+def _conv_plain(x, w, strides, padding):
+    return lax.conv_general_dilated(
+        x, w, strides, padding, dimension_numbers=_DIMNUMS
+    )
+
+
+def _packed_dispatch(x, w, padding):
+    """Stride-1 conv: packed when the policy says so, else plain."""
+    (ph0, ph1), (pw0, pw1) = padding
+    if min(ph0, ph1, pw0, pw1) < 0:
+        # Negative explicit padding (a full-correlation dx whose forward
+        # padding exceeded kernel-1): jnp.pad can't express it; XLA can.
+        return _conv_plain(x, w, (1, 1), padding)
+    h_out = x.shape[1] + ph0 + ph1 - w.shape[0] + 1
+    w_out = x.shape[2] + pw0 + pw1 - w.shape[1] + 1
+    fh, fw = pack_factors(w.shape[0], w.shape[1], w.shape[3], h_out, w_out)
+    if (fh, fw) == (1, 1):
+        return _conv_plain(x, w, (1, 1), padding)
+    return _conv_packed(x, w, padding, fh, fw)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _conv2d_s1(x, w, padding):
+    return _packed_dispatch(x, w, padding)
+
+
+def _conv2d_s1_fwd(x, w, padding):
+    return _packed_dispatch(x, w, padding), (x, w)
+
+
+def _conv2d_s1_bwd(padding, res, dy):
+    x, w = res
+    kh, kw, _, _ = w.shape
+    (ph0, ph1), (pw0, pw1) = padding
+
+    # dx: full correlation with the flipped, io-swapped kernel — a stride-1
+    # small-N conv itself, so it goes through the packed dispatch too.
+    wt = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)  # [kh, kw, O, C]
+    dx_pad = ((kh - 1 - ph0, kh - 1 - ph1), (kw - 1 - pw0, kw - 1 - pw1))
+    dx = _packed_dispatch(dy, wt, dx_pad)
+
+    # dw[u, v, c, o] = sum_{b,h,w} xp[b, h+u, w+v, c] * dy[b, h, w, o]:
+    # conv with x's channels as conv-batch and x's batch as the contraction
+    # ("CHWN" lhs), dy as the kernel — XLA's canonical backward-filter form.
+    xt = x
+    if ph0 or ph1 or pw0 or pw1:
+        xt = jnp.pad(xt, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+    dw = lax.conv_general_dilated(
+        xt,
+        dy,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("CHWN", "IHWO", "NHWC"),
+    )  # out: [C, kh, kw, O]
+    dw = dw.transpose(1, 2, 0, 3).astype(w.dtype)
+    return dx.astype(x.dtype), dw
+
+
+_conv2d_s1.defvjp(_conv2d_s1_fwd, _conv2d_s1_bwd)
+
+
+def conv2d(x, w, strides=(1, 1), padding=((0, 0), (0, 0))):
+    """2-D conv (NHWC x HWIO -> NHWC), explicit padding pairs.
+
+    Uses the MXU-packed formulation (with matching packed backward) for
+    stride-1 convs when profitable on this platform; otherwise identical to
+    ``lax.conv_general_dilated``.
+    """
+    strides = tuple(int(s) for s in strides)
+    padding = tuple((int(p[0]), int(p[1])) for p in padding)
+    impl = conv_impl()
+    use_packed = impl == "packed" or (impl == "auto" and _on_tpu())
+    if not use_packed or strides != (1, 1):
+        return _conv_plain(x, w, strides, padding)
+    return _conv2d_s1(x, w, padding)
+
+
+class FastConv(nn.Module):
+    """Drop-in for ``nn.Conv`` (NHWC, explicit padding) routing through
+    :func:`conv2d`. Parameter tree ("kernel", "bias"), shapes, and
+    initialization match ``nn.Conv`` exactly, so models can swap freely."""
+
+    features: int
+    kernel_size: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: Any = "SAME"  # pairs, "SAME", or "VALID" (nn.Conv default: SAME)
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros_init(), (self.features,), jnp.float32)
+            if self.use_bias
+            else None
+        )
+        x, kernel, bias = nn.dtypes.promote_dtype(x, kernel, bias, dtype=self.dtype)
+        padding = self.padding
+        if padding == "VALID":
+            padding = ((0, 0), (0, 0))
+        elif padding == "SAME":
+            # Explicit SAME pairs (XLA formula), so the packed path applies.
+            def same(dim, k, s):
+                total = max((-(-dim // s) - 1) * s + k - dim, 0)
+                return (total // 2, total - total // 2)
+
+            padding = (same(x.shape[1], kh, sh), same(x.shape[2], kw, sw))
+        y = conv2d(x, kernel, (sh, sw), padding)
+        if bias is not None:
+            y = y + bias
+        return y

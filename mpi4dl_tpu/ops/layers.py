@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi4dl_tpu.config import AXIS_TILE_H, AXIS_TILE_W
+from mpi4dl_tpu.ops.fastconv import FastConv
 from mpi4dl_tpu.parallel.halo import halo_exchange, zero_boundary_halo
 
 TILE_AXES = (AXIS_TILE_H, AXIS_TILE_W)
@@ -136,47 +137,32 @@ class Conv2d(nn.Module):
         else:
             ph, pw = _pair(self.padding)
 
+        conv = FastConv(
+            features=self.features,
+            kernel_size=(kh, kw),
+            strides=(sh, sw),
+            padding="VALID" if self.spatial else ((ph, ph), (pw, pw)),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="conv",
+        )
+
         if not self.spatial:
-            return nn.Conv(
-                features=self.features,
-                kernel_size=(kh, kw),
-                strides=(sh, sw),
-                padding=((ph, ph), (pw, pw)),
-                use_bias=self.use_bias,
-                dtype=self.dtype,
-                name="conv",
-            )(x)
+            return conv(x)
 
         if self.exchange:
             _check_window_coverage(kh, kw, sh, sw, ph, pw)
             h_loc, w_loc = x.shape[1], x.shape[2]
             x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
-            y = nn.Conv(
-                features=self.features,
-                kernel_size=(kh, kw),
-                strides=(sh, sw),
-                padding="VALID",
-                use_bias=self.use_bias,
-                dtype=self.dtype,
-                name="conv",
-            )(x)
             # Trim to this tile's share of the global output grid. The first
             # VALID output aligns with the global grid because tile sizes are
             # multiples of the stride (power-of-two asserts, config.validate).
-            return y[:, : h_loc // sh, : w_loc // sw, :]
+            return conv(x)[:, : h_loc // sh, : w_loc // sw, :]
 
         # D2 shrink conv: input already carries a wide halo; VALID conv eats
         # (k-1) of it per dim. Strided shrink convs are handled by the D2
         # builder's halo-size formulas.
-        return nn.Conv(
-            features=self.features,
-            kernel_size=(kh, kw),
-            strides=(sh, sw),
-            padding="VALID",
-            use_bias=self.use_bias,
-            dtype=self.dtype,
-            name="conv",
-        )(x)
+        return conv(x)
 
 
 class Pool(nn.Module):
