@@ -42,6 +42,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")
 
@@ -49,9 +50,36 @@ _DIMNUMS = ("NHWC", "HWIO", "NHWC")
 # rates keep improving up to ~128 lanes — see docs/PERF.md).
 _TARGET_N = 128
 # Accept at most this much FLOP inflation from kernel scattering.
-_MAX_INFLATE = 3.5
-# Candidate per-axis output-block factors.
-_FACTORS = (1, 2, 4, 8)
+_MAX_INFLATE = 4.0
+# Candidate W-axis output-block factors. H is never packed (fh == 1):
+# with W-only packing the depth-to-space is a pure reshape — the (py, px)
+# interleave transpose that H-packing needs was measured at ~20 ms/step in
+# the backward (profiled at 512px), far more than the FLOP delta between
+# e.g. (2,4) and (1,8) packing.
+_FACTORS_W = (2, 4, 8)
+
+
+_SAVE_COMPACT = False
+
+
+def save_compact_enabled() -> bool:
+    """True while a trainer is tracing under the "scan_save" remat policy
+    (the ``conv_out`` tag + compact reshape are emitted only then, so other
+    policies pay no extra copies)."""
+    return _SAVE_COMPACT
+
+
+class save_conv_outputs:
+    """Context manager enabling the ``conv_out`` tagging during tracing."""
+
+    def __enter__(self):
+        global _SAVE_COMPACT
+        self._prev = _SAVE_COMPACT
+        _SAVE_COMPACT = True
+
+    def __exit__(self, *exc):
+        global _SAVE_COMPACT
+        _SAVE_COMPACT = self._prev
 
 
 def conv_impl() -> str:
@@ -73,37 +101,32 @@ def _on_tpu() -> bool:
 
 
 @functools.lru_cache(maxsize=None)
-def pack_factors(
-    kh: int, kw: int, c_out: int, h_out: int, w_out: int
-) -> tuple[int, int]:
-    """Choose (fh, fw) output-block factors for a stride-1 conv; (1, 1)
-    means "don't pack".
+def pack_factors(kh: int, kw: int, c_out: int, w_out: int) -> tuple[int, int]:
+    """Choose (1, fw) output-block factors for a stride-1 conv; (1, 1)
+    means "don't pack". Only the W axis is ever packed (see ``_FACTORS_W``).
 
     Profitability model from the measured MXU rate curve: rate grows
     ~linearly in N up to ``_TARGET_N`` lanes, while scattering inflates
-    FLOPs by ``(kh+fh-1)(kw+fw-1)/(kh kw)``. Maximize
-    ``min(N', TARGET)/inflation``; require a >1.3x modeled win.
+    FLOPs by ``(kw+fw-1)/kw``. Maximize ``min(N', TARGET)/inflation``;
+    require a >1.3x modeled win.
     """
     if (kh == 1 and kw == 1) or c_out >= _TARGET_N:
         return (1, 1)
 
-    def score(fh: int, fw: int) -> float:
-        inflation = ((kh + fh - 1) * (kw + fw - 1)) / (kh * kw)
+    def score(fw: int) -> float:
+        inflation = (kw + fw - 1) / kw
         if inflation > _MAX_INFLATE:
             return 0.0
-        gain = min(fh * fw * c_out, _TARGET_N) / min(c_out, _TARGET_N)
+        gain = min(fw * c_out, _TARGET_N) / min(c_out, _TARGET_N)
         return gain / inflation
 
     best, best_s = (1, 1), 1.3
-    for fh in _FACTORS:
-        if h_out % fh:
+    for fw in _FACTORS_W:
+        if w_out % fw:
             continue
-        for fw in _FACTORS:
-            if fh * fw == 1 or w_out % fw:
-                continue
-            s = score(fh, fw)
-            if s > best_s:
-                best, best_s = (fh, fw), s
+        s = score(fw)
+        if s > best_s:
+            best, best_s = (1, fw), s
     return best
 
 
@@ -131,13 +154,14 @@ def _depth_to_space(y, fh: int, fw: int):
 
 
 def _conv_packed(x, w, padding, fh: int, fw: int):
-    """Stride-1 conv with explicit padding pairs, packed formulation."""
-    (ph0, ph1), (pw0, pw1) = padding
-    if ph0 or ph1 or pw0 or pw1:
-        x = jnp.pad(x, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+    """Stride-1 conv with explicit padding pairs, packed formulation.
+
+    The padding rides on the strided conv itself (no separate pad copy);
+    window starts are identical to pad-then-VALID since the packed output
+    extent divides exactly (checked by the dispatch policy)."""
     wp = _scatter_kernel(w, fh, fw)
     y = lax.conv_general_dilated(
-        x, wp, (fh, fw), "VALID", dimension_numbers=_DIMNUMS
+        x, wp, (fh, fw), padding, dimension_numbers=_DIMNUMS
     )
     return _depth_to_space(y, fh, fw)
 
@@ -155,9 +179,15 @@ def _packed_dispatch(x, w, padding):
         # Negative explicit padding (a full-correlation dx whose forward
         # padding exceeded kernel-1): jnp.pad can't express it; XLA can.
         return _conv_plain(x, w, (1, 1), padding)
-    h_out = x.shape[1] + ph0 + ph1 - w.shape[0] + 1
+    if w.shape[0] == 1 and w.shape[1] == 1 and max(ph0, ph1, pw0, pw1) == 0:
+        # 1x1 conv: a plain matmul over pixels. Layout packing can't help
+        # (FLOP inflation exactly cancels the lane gain) but skipping the
+        # conv lowering measurably does.
+        b, h, ww, c = x.shape
+        y = x.reshape(-1, c) @ w.reshape(c, w.shape[3])
+        return y.reshape(b, h, ww, w.shape[3])
     w_out = x.shape[2] + pw0 + pw1 - w.shape[1] + 1
-    fh, fw = pack_factors(w.shape[0], w.shape[1], w.shape[3], h_out, w_out)
+    fh, fw = pack_factors(w.shape[0], w.shape[1], w.shape[3], w_out)
     if (fh, fw) == (1, 1):
         return _conv_plain(x, w, (1, 1), padding)
     return _conv_packed(x, w, padding, fh, fw)
@@ -186,9 +216,16 @@ def _conv2d_s1_bwd(padding, res, dy):
     # dw[u, v, c, o] = sum_{b,h,w} xp[b, h+u, w+v, c] * dy[b, h, w, o]:
     # conv with x's channels as conv-batch and x's batch as the contraction
     # ("CHWN" lhs), dy as the kernel — XLA's canonical backward-filter form.
+    # Measured FAST at these shapes (0.19 ms for 3x3/16ch @1024px) — a
+    # "packed wgrad" variant (space-to-depth dy + dilated kernel) was 16x
+    # slower, so the stock form stays.
     xt = x
     if ph0 or ph1 or pw0 or pw1:
-        xt = jnp.pad(xt, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+        xt = lax.pad(
+            x,
+            jnp.zeros((), x.dtype),
+            ((0, 0, 0), (ph0, ph1, 0), (pw0, pw1, 0), (0, 0, 0)),
+        )
     dw = lax.conv_general_dilated(
         xt,
         dy,
@@ -196,8 +233,8 @@ def _conv2d_s1_bwd(padding, res, dy):
         padding="VALID",
         dimension_numbers=("CHWN", "IHWO", "NHWC"),
     )  # out: [C, kh, kw, O]
-    dw = dw.transpose(1, 2, 0, 3).astype(w.dtype)
-    return dx.astype(x.dtype), dw
+    dw = dw.transpose(1, 2, 0, 3)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
 _conv2d_s1.defvjp(_conv2d_s1_fwd, _conv2d_s1_bwd)
@@ -260,4 +297,16 @@ class FastConv(nn.Module):
         y = conv2d(x, kernel, (sh, sw), padding)
         if bias is not None:
             y = y + bias
-        return y
+        # Tag for the "scan_save" remat policy (convs then run once in
+        # forward — backward recomputes only the cheap elementwise/BN
+        # segments between conv outputs). When saving is active, tag a
+        # compact [B, H, W*C] view: small-channel NHWC tensors store ~8x
+        # larger in HBM (minor dim padded to the 128-lane tile), which is
+        # exactly the footprint the policy is spending memory on.
+        if not save_compact_enabled():
+            return y
+        if y.ndim == 4 and y.shape[-1] < 128:
+            shape = y.shape
+            yc = checkpoint_name(y.reshape(shape[0], shape[1], -1), "conv_out")
+            return yc.reshape(shape)
+        return checkpoint_name(y, "conv_out")

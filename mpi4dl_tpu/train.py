@@ -123,9 +123,10 @@ class Trainer:
         2048px bs1 from 24.8G to 16.3G."""
         if num_spatial_cells > 0 and plain_cells is None:
             raise ValueError("spatial models need plain_cells for initialization")
-        if remat not in (False, True, "cell", "sqrt", "scan"):
+        if remat not in (False, True, "cell", "sqrt", "scan", "scan_save"):
             raise ValueError(
-                f"remat must be False, True, 'cell', 'sqrt' or 'scan', got {remat!r}"
+                "remat must be False, True, 'cell', 'sqrt', 'scan' or "
+                f"'scan_save', got {remat!r}"
             )
         self.remat = remat
         self.cells = list(cells)
@@ -216,20 +217,40 @@ class Trainer:
         return plans
 
     def _apply_cells_scan(self, params, x):
-        """The "scan" remat policy (see ``__init__``): scan over repeated
-        cells with compact ``[B, H, W*C]`` carries, barriers between the
-        rest."""
+        """The "scan" / "scan_save" remat policies (see ``__init__``): scan
+        over repeated cells with compact ``[B, H, W*C]`` carries, barriers
+        between the rest. "scan_save" additionally saves every conv output
+        (tagged ``conv_out`` by ``FastConv``), so the backward recomputes
+        only the elementwise/BN segments between convs — +25% conv FLOPs
+        avoided for ~the activations' footprint in HBM."""
         key = (tuple(x.shape), x.dtype)
         if getattr(self, "_scan_plan_key", None) != key:
             self._scan_plan = self._plan_scan_runs(params, x)
             self._scan_plan_key = key
+        if self.remat == "scan_save":
+            from mpi4dl_tpu.ops.fastconv import save_conv_outputs
+
+            with save_conv_outputs():
+                return self._apply_scan_plan(
+                    params,
+                    x,
+                    functools.partial(
+                        jax.checkpoint,
+                        policy=jax.checkpoint_policies.save_only_these_names(
+                            "conv_out"
+                        ),
+                    ),
+                )
+        return self._apply_scan_plan(params, x, jax.checkpoint)
+
+    def _apply_scan_plan(self, params, x, ckpt):
         h = x
         for run in self._scan_plan:
             if len(run) == 1:
                 i = run[0]
                 if i == self.n_spatial and self.n_spatial > 0:
                     h = gather_tiles(h)
-                h = jax.checkpoint(self.cells[i].apply)(params[i], h)
+                h = ckpt(self.cells[i].apply)(params[i], h)
                 h = lax.optimization_barrier(h)
                 continue
             if run[0] == self.n_spatial and self.n_spatial > 0:
@@ -245,7 +266,7 @@ class Trainer:
                 return o.reshape(o.shape[0], o.shape[1], -1)
 
             def body(hc, p):
-                return jax.checkpoint(apply_compact)(p, hc), None
+                return ckpt(apply_compact)(p, hc), None
 
             hc = h.reshape(h.shape[0], h.shape[1], -1)
             hc, _ = lax.scan(body, hc, stacked)
@@ -261,7 +282,7 @@ class Trainer:
                 h = gather_tiles(h)
             return self.cells[i].apply(p, h)
 
-        if self.remat == "scan":
+        if self.remat in ("scan", "scan_save"):
             return self._apply_cells_scan(params, x)
         if self.remat in (True, "cell"):
             h = x

@@ -105,14 +105,14 @@ def test_strided_conv_falls_back_and_matches(monkeypatch):
 
 def test_pack_factors_policy():
     # 1x1 never packs
-    assert fastconv.pack_factors(1, 1, 16, 64, 64) == (1, 1)
+    assert fastconv.pack_factors(1, 1, 16, 64) == (1, 1)
     # >=128 output channels never packs
-    assert fastconv.pack_factors(3, 3, 128, 64, 64) == (1, 1)
-    # small-N 3x3 packs, factors divide the output extents
-    fh, fw = fastconv.pack_factors(3, 3, 16, 64, 64)
-    assert fh * fw > 1 and 64 % fh == 0 and 64 % fw == 0
-    # indivisible output extents: no packing
-    assert fastconv.pack_factors(3, 3, 16, 7, 7) == (1, 1)
+    assert fastconv.pack_factors(3, 3, 128, 64) == (1, 1)
+    # small-N 3x3 packs along W only, factor divides the output extent
+    fh, fw = fastconv.pack_factors(3, 3, 16, 64)
+    assert fh == 1 and fw > 1 and 64 % fw == 0
+    # indivisible output extent: no packing
+    assert fastconv.pack_factors(3, 3, 16, 7) == (1, 1)
 
 
 def test_fastconv_module_params_match_nn_conv(monkeypatch):
