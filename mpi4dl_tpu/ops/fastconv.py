@@ -213,12 +213,18 @@ def _conv2d_s1_bwd(padding, res, dy):
     dx_pad = ((kh - 1 - ph0, kh - 1 - ph1), (kw - 1 - pw0, kw - 1 - pw1))
     dx = _packed_dispatch(dy, wt, dx_pad)
 
-    # dw[u, v, c, o] = sum_{b,h,w} xp[b, h+u, w+v, c] * dy[b, h, w, o]:
-    # conv with x's channels as conv-batch and x's batch as the contraction
-    # ("CHWN" lhs), dy as the kernel — XLA's canonical backward-filter form.
-    # Measured FAST at these shapes (0.19 ms for 3x3/16ch @1024px) — a
-    # "packed wgrad" variant (space-to-depth dy + dilated kernel) was 16x
-    # slower, so the stock form stays.
+    # dw[u, v, c, o] = sum_{b,h,w} xp[b, h+u, w+v, c] * dy[b, h, w, o].
+    # 1x1: that's a plain x^T @ dy dot over pixels — no conv machinery.
+    if kh == 1 and kw == 1 and max(ph0, ph1, pw0, pw1) == 0:
+        c, o = x.shape[-1], dy.shape[-1]
+        dw = lax.dot_general(
+            x.reshape(-1, c),
+            dy.reshape(-1, o),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(1, 1, c, o)
+        return dx.astype(x.dtype), dw.astype(w.dtype)
+
     xt = x
     if ph0 or ph1 or pw0 or pw1:
         xt = lax.pad(
@@ -226,14 +232,25 @@ def _conv2d_s1_bwd(padding, res, dy):
             jnp.zeros((), x.dtype),
             ((0, 0, 0), (ph0, ph1, 0), (pw0, pw1, 0), (0, 0, 0)),
         )
-    dw = lax.conv_general_dilated(
-        xt,
-        dy,
-        window_strides=(1, 1),
-        padding="VALID",
-        dimension_numbers=("CHWN", "IHWO", "NHWC"),
-    )  # out: [C, kh, kw, O]
-    dw = dw.transpose(1, 2, 0, 3)
+
+    # k x k: the Pallas streaming kernel on TPU (XLA's backward-filter conv
+    # contracts over batch, forcing T(2,128) tilings — it profiled
+    # HBM-bound at 30-75 GB/s plus two full-tensor layout copies; the
+    # kernel reads each operand once in natural layout). Fallback: the
+    # canonical "CHWN" form.
+    from mpi4dl_tpu.ops import wgrad_pallas
+
+    if _on_tpu() and wgrad_pallas.supported(xt.shape, dy.shape, kh, kw):
+        dw = wgrad_pallas.wgrad(xt, dy, kh, kw)
+    else:
+        dw = lax.conv_general_dilated(
+            xt,
+            dy,
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("CHWN", "IHWO", "NHWC"),
+        )  # out: [C, kh, kw, O]
+        dw = dw.transpose(1, 2, 0, 3)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 

@@ -1,0 +1,132 @@
+"""Pallas TPU kernel for the 3x3 conv weight gradient (stride-1, NHWC).
+
+Why: profiling (docs/PERF.md) showed the backward-filter convolution is the
+train step's single largest cost class on the bench device. XLA lowers it as
+a conv contracting over the *batch* dimension (2 examples), which forces
+T(2,128) operand tilings — each wgrad ran HBM-bound at 30-75 GB/s AND paid
+two full-tensor layout copies to feed it.
+
+This kernel streams x and dy through VMEM exactly once in their natural
+NHWC layouts (no relayout copies) and accumulates the [kh*kw, C, O] tap
+gradients in a VMEM f32 scratch across a (batch x row-chunk) grid:
+
+    dw[u, v, c, o] = sum_{b,h,w} xp[b, h+u, w+v, c] * dy[b, h, w, o]
+
+Per grid step it reads one aligned [TH, Wp, C] slab of the padded input
+(plus a separate (kh-1)-row "tail" block of the same array — Pallas block
+index maps can't express overlapping windows, so the overlap rows come in
+through a second BlockSpec) and the matching [TH, Wo, O] slab of dy, and
+contracts them tap-by-tap with ``lax.dot_general`` over the flattened pixel
+dimension (K = TH*Wo, f32 accumulation). Bandwidth-bound by design: each
+operand crosses HBM once.
+
+1x1 wgrads don't need this kernel — they are a plain ``x^T @ dy`` dot
+(:func:`mpi4dl_tpu.ops.fastconv._conv2d_s1_bwd` handles that inline).
+
+Exactness: same products as the stock wgrad, f32 accumulation, summation
+regrouped per (batch, row-chunk) — ``tests/test_wgrad_pallas.py`` checks
+math in interpreter mode; the TPU dispatch path is exercised by the bench.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-chunk height. Must divide Ho and be a multiple of (kh - 1).
+_TH = 8
+
+
+def _wgrad_kernel(x_ref, xtail_ref, dy_ref, out_ref, acc_ref, *, kh, kw, th):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # [th + kh - 1, Wp, C] slab: aligned block + overlap tail rows.
+    x = jnp.concatenate([x_ref[0], xtail_ref[0]], axis=0)
+    dy = dy_ref[0]  # [th, Wo, O]
+    wo = dy.shape[1]
+    dyf = dy.reshape(th * wo, dy.shape[2])
+    # All taps in ONE dot: patches [K, kh*kw*C] (tap-major, channel-minor)
+    # against dy [K, O]. M = kh*kw*C fills the MXU far better than C alone
+    # (9 separate [K,C]^T dots measured ~4x slower at C=16).
+    taps = [
+        lax.slice(x, (u, v, 0), (u + th, v + wo, x.shape[2]))
+        for u in range(kh)
+        for v in range(kw)
+    ]
+    patches = jnp.concatenate(taps, axis=-1).reshape(th * wo, -1)
+    acc_ref[...] += lax.dot_general(
+        patches,
+        dyf,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == n - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...]
+
+
+def supported(xp_shape, dy_shape, kh: int, kw: int) -> bool:
+    """Shape gate: stride-1 3x3-class kernels, power-of-two-ish extents."""
+    b, hp, wp, c = xp_shape
+    _, ho, wo, o = dy_shape
+    if kh < 2:  # 1x1 wgrad is a plain dot; handled by the caller
+        return False
+    if hp != ho + kh - 1 or wp < wo + kw - 1:
+        return False
+    if ho % _TH or _TH % (kh - 1):
+        return False
+    x_bytes = (_TH + kh - 1) * wp * c * 2
+    dy_bytes = _TH * wo * o * 2
+    acc_bytes = kh * kw * c * o * 4
+    return x_bytes + dy_bytes + 2 * acc_bytes < 12 * 1024 * 1024
+
+
+@functools.partial(jax.jit, static_argnames=("kh", "kw", "interpret"))
+def wgrad(xp, dy, kh: int, kw: int, interpret: bool = False):
+    """dw[kh, kw, C, O] (f32) for a stride-1 conv.
+
+    xp: [B, Ho + kh - 1, Wp, C] pre-padded input (Wp >= Wo + kw - 1).
+    dy: [B, Ho, Wo, O] output cotangent.
+    """
+    b, hp, wp, c = xp.shape
+    _, ho, wo, o = dy.shape
+    assert supported(xp.shape, dy.shape, kh, kw), (xp.shape, dy.shape, kh, kw)
+    th = _TH
+    rows = ho // th
+    tail = kh - 1
+    grid = (b * rows,)
+
+    out = pl.pallas_call(
+        functools.partial(_wgrad_kernel, kh=kh, kw=kw, th=th),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, th, wp, c), lambda i: (i // rows, i % rows, 0, 0)
+            ),
+            # Overlap rows [chunk_end, chunk_end + kh - 1) as an aligned
+            # block of height (kh - 1): element row (i%rows + 1) * th.
+            pl.BlockSpec(
+                (1, tail, wp, c),
+                lambda i: (i // rows, (i % rows + 1) * (th // tail), 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, th, wo, o), lambda i: (i // rows, i % rows, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((kh * kw * c, o), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((kh * kw * c, o), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((kh * kw * c, o), jnp.float32)],
+        interpret=interpret,
+    )(xp, xp, dy)
+    return out.reshape(kh, kw, c, o)

@@ -1,0 +1,52 @@
+"""Pallas wgrad kernel vs the stock XLA backward-filter conv (interpreter
+mode — same math on CPU; the TPU lowering is exercised by bench runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from mpi4dl_tpu.ops import wgrad_pallas
+
+
+def _ref_wgrad(xp, dy, kh, kw):
+    wo = dy.shape[2]
+    xt = xp[:, :, : wo + kw - 1, :]
+    dw = lax.conv_general_dilated(
+        xt,
+        dy,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("CHWN", "IHWO", "NHWC"),
+    )  # [C, kh, kw, O]
+    return dw.transpose(1, 2, 0, 3)
+
+
+@pytest.mark.parametrize(
+    "b,ho,wo,c,o,k",
+    [
+        (2, 16, 16, 5, 7, 3),
+        (1, 8, 24, 4, 4, 3),
+        (2, 32, 8, 3, 5, 5),  # 5x5: tail = 4, th=8 multiple of 4
+    ],
+)
+def test_wgrad_matches_xla(b, ho, wo, c, o, k):
+    rng = np.random.default_rng(0)
+    xp = jnp.asarray(
+        rng.standard_normal((b, ho + k - 1, wo + k - 1, c)), jnp.float32
+    )
+    dy = jnp.asarray(rng.standard_normal((b, ho, wo, o)), jnp.float32)
+    assert wgrad_pallas.supported(xp.shape, dy.shape, k, k)
+    got = wgrad_pallas.wgrad(xp, dy, k, k, interpret=True)
+    want = _ref_wgrad(xp, dy, k, k)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_supported_gate():
+    # 1x1 → plain dot, not this kernel
+    assert not wgrad_pallas.supported((2, 16, 16, 4), (2, 16, 16, 8), 1, 1)
+    # Ho not divisible by the row chunk
+    assert not wgrad_pallas.supported((2, 15, 18, 4), (2, 13, 16, 8), 3, 3)
+    # mismatched padded height
+    assert not wgrad_pallas.supported((2, 16, 18, 4), (2, 16, 16, 8), 3, 3)
