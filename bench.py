@@ -82,9 +82,15 @@ def main():
             float(metrics["loss"])
             break
         except jax.errors.JaxRuntimeError as e:  # OOM → leaner policy
-            if remat == remats[-1]:
+            # Only genuine memory exhaustion justifies retrying with a
+            # leaner remat policy; anything else (e.g. a kernel compile
+            # failure) must surface immediately, not after a doubled
+            # time-to-failure (ADVICE.md round-1 low finding).
+            msg = str(e)
+            is_oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            if not is_oom or remat == remats[-1]:
                 raise
-            print(f"# remat={remat} failed ({type(e).__name__}); retrying", flush=True)
+            print(f"# remat={remat} OOM; retrying leaner", flush=True)
             state = trainer = None
 
     t0 = time.perf_counter()

@@ -240,7 +240,7 @@ def _conv2d_s1_bwd(padding, res, dy):
     # canonical "CHWN" form.
     from mpi4dl_tpu.ops import wgrad_pallas
 
-    if _on_tpu() and wgrad_pallas.supported(xt.shape, dy.shape, kh, kw):
+    if _on_tpu() and wgrad_pallas.usable(xt, dy, kh, kw):
         dw = wgrad_pallas.wgrad(xt, dy, kh, kw)
     else:
         dw = lax.conv_general_dilated(
