@@ -245,8 +245,22 @@ def run_training(args, trainer, tag: str):
     if ckpt_dir:
         ckpt.save_checkpoint(ckpt_dir, state)
     if perf:
-        print(
-            f"{tag}: Mean {statistics.mean(perf):.3f} img/s "
+        mean_ips = statistics.mean(perf)
+        line = (
+            f"{tag}: Mean {mean_ips:.3f} img/s "
             f"Median {statistics.median(perf):.3f} img/s"
         )
+        # MFU against the model's analytic FLOPs (BASELINE.json north star
+        # is stated in MFU; the reference never reports it). Counted on the
+        # plain twin — same math, no spatial collectives to trace.
+        try:
+            from mpi4dl_tpu.flops import mfu, train_flops_per_image
+
+            fpi = train_flops_per_image(trainer.plain_cells, cfg.image_size)
+            util = mfu(mean_ips, fpi, n_devices=jax.device_count())
+            if util is not None:
+                line += f" MFU {100 * util:.1f}%"
+        except Exception as e:  # never let accounting kill a benchmark
+            line += f" (MFU unavailable: {e})"
+        print(line)
     return state

@@ -93,6 +93,30 @@ def conv_impl() -> str:
     return impl
 
 
+def _wgrad_impl_allows(c: int) -> bool:
+    """Pallas-wgrad dispatch policy. ``MPI4DL_TPU_WGRAD_IMPL`` = ``xla``
+    (default; never dispatch the kernel) | ``pallas`` (dispatch wherever
+    the kernel's shape gate + compile probe admit, bounded by
+    ``MPI4DL_TPU_WGRAD_CMAX`` input channels). Read at trace time so
+    benchmark processes can A/B the dispatch without code edits.
+
+    Default is XLA's backward-filter conv because it wins END TO END:
+    standalone the Pallas kernel is 3-9x faster (docs/PERF.md round-2
+    table), but in the full train step XLA chooses operand layouts
+    globally and fuses the wgrad with its neighbors, and the measured
+    bench is 2.296 img/s (xla) vs 2.252 (pallas C<=16) vs 2.117 (pallas
+    everywhere). Standalone microbenchmarks mislead on this device."""
+    impl = os.environ.get("MPI4DL_TPU_WGRAD_IMPL", "xla")
+    if impl not in ("pallas", "xla"):
+        raise ValueError(
+            f"MPI4DL_TPU_WGRAD_IMPL must be pallas|xla, got {impl!r}"
+        )
+    if impl == "xla":
+        return False
+    cmax = int(os.environ.get("MPI4DL_TPU_WGRAD_CMAX", "1024"))
+    return c <= cmax
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
@@ -233,14 +257,16 @@ def _conv2d_s1_bwd(padding, res, dy):
             ((0, 0, 0), (ph0, ph1, 0), (pw0, pw1, 0), (0, 0, 0)),
         )
 
-    # k x k: the Pallas streaming kernel on TPU (XLA's backward-filter conv
-    # contracts over batch, forcing T(2,128) tilings — it profiled
-    # HBM-bound at 30-75 GB/s plus two full-tensor layout copies; the
-    # kernel reads each operand once in natural layout). Fallback: the
-    # canonical "CHWN" form.
+    # k x k: the Pallas streaming kernel on TPU when the dispatch policy
+    # admits the shape (see wgrad_impl_allows); fallback: the canonical
+    # "CHWN" backward-filter conv.
     from mpi4dl_tpu.ops import wgrad_pallas
 
-    if _on_tpu() and wgrad_pallas.usable(xt, dy, kh, kw):
+    if (
+        _on_tpu()
+        and _wgrad_impl_allows(x.shape[-1])
+        and wgrad_pallas.usable(xt, dy, kh, kw)
+    ):
         dw = wgrad_pallas.wgrad(xt, dy, kh, kw)
     else:
         dw = lax.conv_general_dilated(

@@ -1,0 +1,117 @@
+"""Capture an xprof trace of the bench train step and print op stats.
+
+Usage: python scripts/profile_step.py [--model resnet|amoebanet]
+       [--image-size 1024] [--batch 2] [--steps 3] [--out /tmp/trace]
+
+Prints the framework_op_stats table (top ops by self-time) so perf work
+targets measured costs, not standalone microbenchmarks (which round 2
+showed can mislead by 5x on this device — docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.train import Trainer
+
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    if args.model == "resnet":
+        from mpi4dl_tpu.models.resnet import get_resnet_v2
+        from mpi4dl_tpu.utils import get_depth
+
+        cells = get_resnet_v2(
+            depth=get_depth(2, 12), num_classes=10,
+            pool_kernel=args.image_size // 4, dtype=dtype,
+        )
+    else:
+        from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+        cells = amoebanetd(
+            num_classes=10, num_layers=18, num_filters=416, dtype=dtype
+        )
+    cfg = ParallelConfig(
+        batch_size=args.batch, split_size=1, spatial_size=0,
+        image_size=args.image_size,
+    )
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat=args.remat)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((args.batch, args.image_size, args.image_size, 3)),
+        dtype,
+    )
+    y = jnp.asarray(rng.integers(0, 10, size=(args.batch,)), jnp.int32)
+    xs, ys = trainer.shard_batch(x, y)
+    state = trainer.init(jax.random.PRNGKey(0), x.shape, dtype=dtype)
+    for _ in range(2):  # compile + warm
+        state, m = trainer.train_step(state, xs, ys)
+    float(m["loss"])
+    with jax.profiler.trace(args.out):
+        for _ in range(args.steps):
+            state, m = trainer.train_step(state, xs, ys)
+        float(m["loss"])
+    print(f"trace written to {args.out}", file=sys.stderr)
+
+
+def report(out_dir, top=30):
+    """framework_op_stats via the xprof/tensorboard-plugin-profile convert
+    API (no TensorBoard UI needed)."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    runs = sorted(glob.glob(os.path.join(out_dir, "plugins/profile/*")))
+    assert runs, f"no profile runs under {out_dir}"
+    run = runs[-1]
+    xspaces = glob.glob(os.path.join(run, "*.xplane.pb"))
+    data, _ = rtd.xspace_to_tool_data(xspaces, "framework_op_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    tbl = json.loads(data)
+    tbl = tbl[0] if isinstance(tbl, list) else tbl
+    cols = [c["id"] for c in tbl["cols"]]
+    rows = [dict(zip(cols, [c["v"] for c in r["c"]])) for r in tbl["rows"]]
+    dev = [r for r in rows if r.get("host_or_device") == "Device"]
+    total = sum(r["self_time"] for r in dev)
+    print(f"total device self_time: {total / 1e3:.2f} ms (all captured steps)")
+    by_type = {}
+    for r in dev:
+        by_type[r["type"]] = by_type.get(r["type"], 0.0) + r["self_time"]
+    print("-- by op type --")
+    for t, v in sorted(by_type.items(), key=lambda kv: -kv[1])[:14]:
+        print(f"{t:38s} {v / 1e3:9.2f} ms  {100 * v / total:5.1f}%")
+    print(f"-- top {top} individual ops --")
+    for r in sorted(dev, key=lambda r: -r["self_time"])[:top]:
+        print(
+            f"{r['self_time'] / 1e3:8.2f} ms {100 * r['self_time'] / total:5.1f}% "
+            f"x{r['occurrences']:<4} {r['type']:26s} {r['operation'][:70]}"
+        )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet", choices=["resnet", "amoebanet"])
+    ap.add_argument("--image-size", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--remat", default="scan_save")
+    ap.add_argument("--out", default="/tmp/mpi4dl_trace")
+    ap.add_argument("--report-only", action="store_true")
+    args = ap.parse_args()
+    if not args.report_only:
+        capture(args)
+    report(args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""FLOP accounting tests: analytic counts on known-cost layers."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi4dl_tpu.flops import forward_flops, mfu, train_flops_per_image
+from mpi4dl_tpu.ops.fastconv import FastConv
+
+
+def test_conv_flops_analytic():
+    # 3x3 SAME conv, 8->16ch @ 32x32: 2 * H*W*O * KH*KW*Cin MACs-as-FLOPs.
+    cell = FastConv(features=16, kernel_size=(3, 3), use_bias=False)
+    got = forward_flops([cell], (1, 32, 32, 8))
+    want = 2 * 32 * 32 * 16 * 3 * 3 * 8
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_flops_scale_with_batch_and_resolution():
+    cell = FastConv(features=16, kernel_size=(3, 3), use_bias=False)
+    f1 = forward_flops([cell], (1, 32, 32, 8))
+    f2 = forward_flops([cell], (4, 32, 32, 8))
+    f3 = forward_flops([cell], (1, 64, 64, 8))
+    np.testing.assert_allclose(f2, 4 * f1, rtol=1e-6)
+    np.testing.assert_allclose(f3, 4 * f1, rtol=1e-6)
+
+
+def test_resnet_train_flops_sane():
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+
+    # depth 9n+2 → BOTTLENECK v2 blocks (3 convs, 4x expansion): much more
+    # FLOPs than the classic basic-block CIFAR ResNet of the same depth.
+    cells = get_resnet_v2(depth=20, num_classes=10, pool_kernel=8)
+    fwd = train_flops_per_image(cells, 32) / 3
+    assert 150e6 < fwd < 400e6, fwd
+    # Quadratic in resolution.
+    fwd2 = train_flops_per_image(cells, 64) / 3
+    np.testing.assert_allclose(fwd2 / fwd, 4.0, rtol=0.05)
+
+
+def test_mfu_none_off_tpu():
+    assert mfu(10.0, 1e12) is None  # CPU test process: unknown peak
