@@ -122,20 +122,38 @@ def main():
     # to skip the backward's forward-recompute; it fits up to ~2M pixels
     # per example on one chip — try it first, fall back to "scan" on OOM.
     remat_pref = os.environ.get("BENCH_REMAT")
-    remats = [remat_pref] if remat_pref else ["scan_save", "scan"]
+    # cell_save first (fastest, most memory), then the leaner scan policies
+    # on OOM (2048px+).
+    remats = [remat_pref] if remat_pref else ["cell_save", "scan_save", "scan"]
 
     result = {}
     extras = {}
 
     if which in ("resnet", "all"):
         depth = get_depth(2, 12)  # 110 — the reference benchmark's ResNet
+        # Packed activation layout (ops/packed.py): measured win on TPU;
+        # BENCH_LAYOUT=nhwc reverts to the stock layout for A/B.
+        layout = os.environ.get(
+            "BENCH_LAYOUT", "packed" if not on_cpu else "nhwc"
+        )
         cells = get_resnet_v2(
-            depth=depth, num_classes=10, pool_kernel=image_size // 4, dtype=dtype
+            depth=depth, num_classes=10, pool_kernel=image_size // 4,
+            layout=layout, dtype=dtype,
         )
         ips, remat = _train_throughput(
             cells, image_size, batch, steps, warmup, dtype, remats
         )
-        util = mfu(ips, train_flops_per_image(cells, image_size, dtype))
+        # MFU counts the LOGICAL model's FLOPs (stock layout) — the packed
+        # layout executes more device FLOPs by design and must not flatter
+        # the utilization number.
+        logical = get_resnet_v2(
+            depth=depth, num_classes=10, pool_kernel=image_size // 4, dtype=dtype
+        )
+        util = mfu(
+            ips,
+            train_flops_per_image(logical, image_size, dtype),
+            n_devices=jax.device_count(),
+        )
         result = {
             "metric": f"resnet110_{image_size}px_bs{batch}_train_{platform}",
             "value": round(ips, 3),
@@ -161,7 +179,11 @@ def main():
             except Exception as e:  # noqa: BLE001 — extras never kill the line
                 extras[tag] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
                 continue
-            util = mfu(ips, train_flops_per_image(cells, size, dtype))
+            util = mfu(
+                ips,
+                train_flops_per_image(cells, size, dtype),
+                n_devices=jax.device_count(),
+            )
             entry = {
                 "value": round(ips, 3),
                 "remat": remat,

@@ -28,12 +28,15 @@ import numpy as np
 
 
 # Peak dense bf16 FLOP/s per chip (public spec sheets). device_kind strings
-# as reported by jax.devices()[0].device_kind.
+# as reported by jax.devices()[0].device_kind. Longest-prefix match so lite
+# variants never fall through to their full-size generation.
 _PEAK_FLOPS = {
+    "TPU v4 lite": 138e12,  # v4i
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,  # v5e
-    "TPU v5": 459e12,  # v5p
+    "TPU v5e": 197e12,
     "TPU v5p": 459e12,
+    "TPU v5": 459e12,
     "TPU v6 lite": 918e12,  # Trillium
     "TPU v6e": 918e12,
 }
@@ -45,9 +48,9 @@ def peak_flops(device=None) -> float | None:
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "")
-    for name, peak in _PEAK_FLOPS.items():
+    for name in sorted(_PEAK_FLOPS, key=len, reverse=True):
         if kind.startswith(name):
-            return peak
+            return _PEAK_FLOPS[name]
     return None
 
 

@@ -71,6 +71,7 @@ class ResNetLayer(nn.Module):
     bn_interior: tuple[int, int] = (0, 0)
     zero_halo: tuple[int, int] = (0, 0)  # re-zero outside-image halo pre-conv
     bn_reduce_axes: tuple[str, ...] = ()
+    pack: tuple[int, int] = (1, 1)  # packed activation layout (ops/packed.py)
     dtype: Any = None
 
     @nn.compact
@@ -84,19 +85,30 @@ class ResNetLayer(nn.Module):
             padding=self.padding,
             spatial=self.spatial,
             exchange=self.exchange,
+            pack=self.pack,
             dtype=self.dtype,
             name="conv",
         )
-        bn = (
-            TrainBatchNorm(
+        if not self.batch_normalization:
+            bn = None
+        elif (self.pack[0] if self.conv_first is False else self.pack[1]) > 1:
+            # BN sees the conv's input (pre-activation) or output
+            # (conv_first) — packed either way under the packed layout.
+            from mpi4dl_tpu.ops.packed import PackedTrainBatchNorm
+
+            bn = PackedTrainBatchNorm(
+                pack=self.pack[0] if not self.conv_first else self.pack[1],
+                reduce_axes=self.bn_reduce_axes,
+                dtype=self.dtype,
+                name="bn",
+            )
+        else:
+            bn = TrainBatchNorm(
                 reduce_axes=self.bn_reduce_axes,
                 interior=self.bn_interior,
                 dtype=self.dtype,
                 name="bn",
             )
-            if self.batch_normalization
-            else None
-        )
         if self.conv_first:
             x = conv(x)
             if bn is not None:
@@ -156,6 +168,7 @@ class CellV2(nn.Module):
     batch_normalization: bool = True
     spatial: bool = False
     bn_reduce_axes: tuple[str, ...] = ()
+    pack: tuple[int, int] = (1, 1)  # (f_in, f_mid) packed layout factors
     dtype: Any = None
 
     @nn.compact
@@ -163,18 +176,24 @@ class CellV2(nn.Module):
         common = dict(
             spatial=self.spatial, bn_reduce_axes=self.bn_reduce_axes, dtype=self.dtype
         )
+        f_in, f_mid = self.pack
         y = ResNetLayer(
             self.features1,
             strides=self.strides,
             activation=self.activation,
             batch_normalization=self.batch_normalization,
             conv_first=False,
+            pack=(f_in, f_mid),
             name="r1",
             **common,
         )(x)
-        y = ResNetLayer(self.features1, conv_first=False, name="r2", **common)(y)
         y = ResNetLayer(
-            self.features2, kernel_size=1, conv_first=False, name="r3", **common
+            self.features1, conv_first=False, pack=(f_mid, f_mid), name="r2",
+            **common,
+        )(y)
+        y = ResNetLayer(
+            self.features2, kernel_size=1, conv_first=False,
+            pack=(f_mid, f_mid), name="r3", **common,
         )(y)
         if self.res_block == 0:
             x = ResNetLayer(
@@ -183,6 +202,7 @@ class CellV2(nn.Module):
                 strides=self.strides,
                 activation=None,
                 batch_normalization=False,
+                pack=(f_in, f_mid),
                 name="r4",
                 **common,
             )(x)
@@ -288,7 +308,12 @@ def _v2_specs(depth: int) -> list[dict]:
                     strides = 2
             specs.append(
                 dict(
-                    res_block=res_block,
+                    # Only res_block == 0 changes behavior (the r4 shortcut
+                    # conv); clamping the index makes the later blocks of a
+                    # stage compare EQUAL as module configs, which is what
+                    # lets the "scan" remat policy stack them into one
+                    # lax.scan (train._plan_scan_runs groups by equality).
+                    res_block=min(res_block, 1),
                     strides=strides,
                     features1=features_in,
                     features2=features_out,
@@ -321,12 +346,25 @@ class HeadV2(nn.Module):
     num_classes: int
     pool_kernel: int = 8
     bn_reduce_axes: tuple[str, ...] = ()
+    pack: int = 1  # packed layout factor of the incoming activation
     dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
-        x = TrainBatchNorm(reduce_axes=self.bn_reduce_axes, dtype=self.dtype, name="bn")(x)
-        x = nn.relu(x)
+        if self.pack > 1:
+            from mpi4dl_tpu.ops.packed import PackedTrainBatchNorm, unpack
+
+            x = PackedTrainBatchNorm(
+                pack=self.pack, reduce_axes=self.bn_reduce_axes,
+                dtype=self.dtype, name="bn",
+            )(x)
+            x = nn.relu(x)
+            x = unpack(x, self.pack)
+        else:
+            x = TrainBatchNorm(
+                reduce_axes=self.bn_reduce_axes, dtype=self.dtype, name="bn"
+            )(x)
+            x = nn.relu(x)
         x = Pool(kind="avg", kernel_size=self.pool_kernel, name="pool")(x)
         return Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
 
@@ -363,8 +401,11 @@ def get_resnet_v1(
             strides = 2 if (stack > 0 and res_block == 0) else 1
             cells.append(
                 CellV1(
-                    stack=stack,
-                    res_block=res_block,
+                    # Clamped indices: only (stack > 0, res_block == 0)
+                    # changes behavior; equal configs let repeated blocks
+                    # scan (see _v2_specs note).
+                    stack=min(stack, 1),
+                    res_block=min(res_block, 1),
                     strides=strides,
                     features=features,
                     spatial=sp(),
@@ -383,13 +424,29 @@ def get_resnet_v2(
     spatial_cells: int = 0,
     cross_tile_bn: bool = True,
     pool_kernel: int = 8,
+    layout: str = "nhwc",
     dtype: Any = jnp.float32,
 ) -> list[nn.Module]:
-    """ResNet v2 as a flat cell list (ref ``get_resnet_v2``, ``resnet.py:270-323``)."""
+    """ResNet v2 as a flat cell list (ref ``get_resnet_v2``, ``resnet.py:270-323``).
+
+    layout="packed" builds the same model on the persistently-packed
+    activation layout (ops/packed.py): identical parameter tree and math
+    (mod f32 accumulation order), up to ~8x less HBM traffic for the
+    small-channel stages on TPU. Non-spatial only.
+    """
+    if layout not in ("nhwc", "packed"):
+        raise ValueError(f"layout must be nhwc|packed, got {layout!r}")
+    if layout == "packed" and spatial_cells:
+        raise ValueError("packed layout does not compose with spatial cells yet")
     cells: list[nn.Module] = []
 
     def sp():
         return len(cells) < spatial_cells
+
+    def f_of(c):
+        from mpi4dl_tpu.ops.packed import pack_factor
+
+        return pack_factor(c) if layout == "packed" else 1
 
     cells.append(
         ResNetLayer(
@@ -397,19 +454,37 @@ def get_resnet_v2(
             conv_first=True,
             spatial=sp(),
             bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+            pack=(1, f_of(16)),
             dtype=dtype,
         )
     )
+    # Pack factors chain through the net: a cell's f_in is the previous
+    # cell's f_mid, and the packed stride s' = strides*f_mid/f_in must be a
+    # positive integer — so a stride-2 cell halves f (never below 1), and f
+    # never drops below what keeps the minormost dim >= 128 when the
+    # channel width allows it.
+    f_prev = f_of(16)
     for spec in _v2_specs(depth):
+        if layout == "packed":
+            f_mid = max(f_of(spec["features1"]), f_prev // spec["strides"])
+        else:
+            f_mid = 1
         cells.append(
             CellV2(
                 spatial=sp(),
                 bn_reduce_axes=_bn_axes(sp(), cross_tile_bn),
+                pack=(f_prev, f_mid),
                 dtype=dtype,
                 **spec,
             )
         )
-    cells.append(HeadV2(num_classes=num_classes, pool_kernel=pool_kernel, dtype=dtype))
+        f_prev = f_mid
+    cells.append(
+        HeadV2(
+            num_classes=num_classes, pool_kernel=pool_kernel, pack=f_prev,
+            dtype=dtype,
+        )
+    )
     return cells
 
 

@@ -134,6 +134,7 @@ class Conv2d(nn.Module):
     use_bias: bool = True
     spatial: bool = False
     exchange: bool = True
+    pack: tuple[int, int] = (1, 1)  # (pack_in, pack_out); (1,1) = NHWC
     dtype: Any = None
 
     @nn.compact
@@ -144,6 +145,25 @@ class Conv2d(nn.Module):
             ph, pw = (kh - 1) // 2, (kw - 1) // 2
         else:
             ph, pw = _pair(self.padding)
+
+        if self.pack != (1, 1):
+            # Persistently-packed activation layout (ops/packed.py): the
+            # input is [B, H, W/pack_in, pack_in*C]; emit packed too.
+            if self.spatial:
+                raise NotImplementedError("packed layout is non-spatial only")
+            from mpi4dl_tpu.ops.packed import PackedConv
+
+            return PackedConv(
+                features=self.features,
+                kernel_size=(kh, kw),
+                pack_in=self.pack[0],
+                pack_out=self.pack[1],
+                strides=(sh, sw),
+                padding=((ph, ph), (pw, pw)),
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                name="conv",
+            )(x)
 
         conv = FastConv(
             features=self.features,

@@ -123,10 +123,12 @@ class Trainer:
         2048px bs1 from 24.8G to 16.3G."""
         if num_spatial_cells > 0 and plain_cells is None:
             raise ValueError("spatial models need plain_cells for initialization")
-        if remat not in (False, True, "cell", "sqrt", "scan", "scan_save"):
+        if remat not in (
+            False, True, "cell", "sqrt", "scan", "scan_save", "cell_save"
+        ):
             raise ValueError(
-                "remat must be False, True, 'cell', 'sqrt', 'scan' or "
-                f"'scan_save', got {remat!r}"
+                "remat must be False, True, 'cell', 'sqrt', 'scan', "
+                f"'scan_save' or 'cell_save', got {remat!r}"
             )
         self.remat = remat
         self.cells = list(cells)
@@ -236,9 +238,19 @@ class Trainer:
         avoided for ~the activations' footprint in HBM."""
         key = (tuple(x.shape), x.dtype)
         if getattr(self, "_scan_plan_key", None) != key:
-            self._scan_plan = self._plan_scan_runs(params, x)
+            if self.remat == "cell_save":
+                # "cell_save": per-cell checkpoints with conv-output saves,
+                # NO stacked-parameter scans. Measured FASTER than
+                # "scan_save" on the packed-layout bench (3.12 vs 2.35
+                # img/s @1024px): separately-compiled cell bodies let XLA
+                # optimize each stage globally, where the single scanned
+                # body pays slicing/uniformity costs. "scan_save" remains
+                # the leaner-memory / faster-compile fallback.
+                self._scan_plan = [[i] for i in range(len(self.cells))]
+            else:
+                self._scan_plan = self._plan_scan_runs(params, x)
             self._scan_plan_key = key
-        if self.remat == "scan_save":
+        if self.remat in ("scan_save", "cell_save"):
             from mpi4dl_tpu.ops.fastconv import save_conv_outputs
 
             with save_conv_outputs():
@@ -315,7 +327,7 @@ class Trainer:
                 h = jax.tree.map(gather_tiles, h)
             return self.cells[i].apply(p, h)
 
-        if self.remat in ("scan", "scan_save"):
+        if self.remat in ("scan", "scan_save", "cell_save"):
             return self._apply_cells_scan(params, x)
         if self.remat in (True, "cell"):
             h = x
