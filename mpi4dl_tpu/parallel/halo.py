@@ -23,8 +23,42 @@ reference is NCHW).
 
 from __future__ import annotations
 
+import contextlib
+
 from jax import lax
 import jax.numpy as jnp
+
+# -- Pallas-impl safety plumbing (see halo_exchange's impl dispatch) ---------
+
+_XLA_ONLY_DEPTH = [0]
+
+
+@contextlib.contextmanager
+def xla_halo_only():
+    """Force the XLA halo implementation while tracing the enclosed region.
+
+    Batched callers (the pipeline's vmapped front) MUST wrap their tracing
+    in this: the Pallas remote-DMA kernel deadlocks under vmap batching,
+    and tracer sniffing cannot see a vmap through initial-style transforms
+    (checkpoint, scan)."""
+    _XLA_ONLY_DEPTH[0] += 1
+    try:
+        yield
+    finally:
+        _XLA_ONLY_DEPTH[0] -= 1
+
+
+def _xla_only_active() -> bool:
+    return _XLA_ONLY_DEPTH[0] > 0
+
+
+def _is_batch_tracer(x) -> bool:
+    try:  # private module — absence must degrade to "don't know", not crash
+        from jax._src.interpreters import batching
+
+        return isinstance(x, batching.BatchTracer)
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
 
 
 def _shift(x, axis_name: str, direction: int):
@@ -84,10 +118,32 @@ def halo_exchange(
     """
     from mpi4dl_tpu.ops.halo_pallas import default_impl, halo_exchange_pallas
 
+    explicit = impl is not None
     if impl is None:
         impl = default_impl()
     if impl == "pallas":
-        return halo_exchange_pallas(x, halo_h, halo_w, axis_h, axis_w, fill_value)
+        # The remote-DMA kernel is only safe UN-batched: under vmap (the
+        # pipeline's micro-batched front) the batching rule adds a grid
+        # dimension whose per-step DMAs interleave across devices and
+        # deadlock (reproduced on the 8-device interpreter mesh). Batched
+        # callers declare themselves with :func:`xla_halo_only` (the
+        # pipeline front does); a tracer sniff backs that up for direct
+        # vmap use, but initial-style transforms (checkpoint/scan) between
+        # the vmap and this call hide the batch tracer — the context
+        # manager is the reliable mechanism.
+        if not _xla_only_active() and not _is_batch_tracer(x):
+            return halo_exchange_pallas(
+                x, halo_h, halo_w, axis_h, axis_w, fill_value
+            )
+        if explicit:
+            import warnings
+
+            warnings.warn(
+                "halo_exchange(impl='pallas') downgraded to the XLA path: "
+                "the Pallas remote-DMA kernel deadlocks under batched "
+                "(vmapped) tracing"
+            )
+        impl = "xla"
     if impl != "xla":
         raise ValueError(f"halo impl must be 'xla' or 'pallas', got {impl!r}")
     b, h, w, c = x.shape

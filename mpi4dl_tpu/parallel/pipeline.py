@@ -372,7 +372,10 @@ class PipelineTrainer:
             )
         else:
             my = x
-        out = jax.vmap(one_microbatch)(my)
+        from mpi4dl_tpu.parallel.halo import xla_halo_only
+
+        with xla_halo_only():  # Pallas halo deadlocks under vmap batching
+            out = jax.vmap(one_microbatch)(my)
         if shard_over_pipe:
             out = jax.tree.map(
                 lambda a: lax.all_gather(a, AXIS_PIPE, axis=0, tiled=True), out
@@ -531,6 +534,10 @@ class PipelineTrainer:
         return fn(params, x, y)
 
     def _train_step(self, state: TrainState, x, y):
+        from mpi4dl_tpu.ops.halo_pallas import reset_collective_ids
+
+        reset_collective_ids()  # deterministic per-program ids (see there)
+
         def loss_fn(params):
             return self._sharded_loss(params, x, y)
 

@@ -386,6 +386,10 @@ class Trainer:
 
     # -- step ----------------------------------------------------------------
     def _train_step(self, state: TrainState, x, y):
+        from mpi4dl_tpu.ops.halo_pallas import reset_collective_ids
+
+        reset_collective_ids()  # deterministic per-program ids (see there)
+
         def loss_fn(params):
             return self._sharded_loss(params, x, y)
 
