@@ -34,7 +34,7 @@ def capture(args):
 
         cells = get_resnet_v2(
             depth=get_depth(2, 12), num_classes=10,
-            pool_kernel=args.image_size // 4, dtype=dtype,
+            pool_kernel=args.image_size // 4, layout=args.layout, dtype=dtype,
         )
     else:
         from mpi4dl_tpu.models.amoebanet import amoebanetd
@@ -104,7 +104,8 @@ def main():
     ap.add_argument("--image-size", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--steps", type=int, default=3)
-    ap.add_argument("--remat", default="scan_save")
+    ap.add_argument("--remat", default="cell_save")
+    ap.add_argument("--layout", default="packed", choices=["nhwc", "packed"])
     ap.add_argument("--out", default="/tmp/mpi4dl_trace")
     ap.add_argument("--report-only", action="store_true")
     args = ap.parse_args()
