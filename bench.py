@@ -127,15 +127,23 @@ def _train_throughput(cells, image_size, batch, steps, warmup, dtype, remats):
             float(metrics["loss"])
             break
         except jax.errors.JaxRuntimeError as e:
-            # Only genuine memory exhaustion justifies retrying with a
-            # leaner remat policy; anything else (e.g. a kernel compile
-            # failure) must surface immediately, not after a doubled
+            # Retry with a leaner remat policy only for failures a smaller
+            # program can actually cure — genuine memory exhaustion, or the
+            # tunneled runtime's remote-compile helper dying on a too-big
+            # program (measured: ResNet@2048 cell_save kills the helper with
+            # an INTERNAL/HTTP-500, while the scan policies compile). Any
+            # other error must surface immediately, not after a doubled
             # time-to-failure (ADVICE.md round-1 low finding).
             msg = str(e)
-            is_oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-            if not is_oom or remat == remats[-1]:
+            retryable = (
+                "RESOURCE_EXHAUSTED" in msg
+                or "Out of memory" in msg
+                or "tpu_compile_helper" in msg
+                or "remote_compile" in msg
+            )
+            if not retryable or remat == remats[-1]:
                 raise
-            print(f"# remat={remat} OOM; retrying leaner", flush=True)
+            print(f"# remat={remat} failed ({msg[:80]!r}); retrying leaner", flush=True)
             state = trainer = None
 
     t0 = time.perf_counter()

@@ -613,7 +613,16 @@ class GemsMasterTrainer(PipelineTrainer):
 
     def _local_loss(self, params, x, y):
         """x: [2*times, parts, mb_local, ...]; chunk 2k → normal direction,
-        chunk 2k+1 → mirrored (ref alternation, ``gems_master.py:72-103``)."""
+        chunk 2k+1 → mirrored (ref alternation, ``gems_master.py:72-103``).
+
+        The chunk loop is a ``lax.scan`` over normal/mirror PAIRS: the
+        compiled program contains exactly two pipeline schedules (one per
+        direction — ``mirror`` changes the static ppermute wiring, so it
+        cannot be a traced value) regardless of ``--times``; the reference's
+        whole point of ``--times`` is raising it for effective batch
+        (``gems_master.py:72-103``), which a Python unroll made quadratic-
+        compile-cost here.
+        """
         front_flat, stacked_local = params
         S = self.S
         flat = stacked_local[0]
@@ -621,20 +630,32 @@ class GemsMasterTrainer(PipelineTrainer):
         flipped = lax.ppermute(
             stacked_local, AXIS_PIPE, [(i, S - 1 - i) for i in range(S)]
         )[0]
-        ce_tot = jnp.zeros((), jnp.float32)
-        cc_tot = jnp.zeros((), jnp.float32)
-        for c in range(self.chunks):
-            xc = jax.tree.map(lambda a: a[c], x)
-            yc = y[c]
+
+        def one_chunk(stage_flat, mirror, xc, yc):
             front_out = self._front(front_flat, xc)
             front_out, yc = self._back_inputs(front_out, yc)
-            mirror = bool(c % 2)
-            preds, stage_of = self._schedule(
-                flipped if mirror else flat, front_out, mirror
-            )
-            ce, cc = self._contributions(preds, yc, stage_of)
-            ce_tot += ce
-            cc_tot += cc
+            preds, stage_of = self._schedule(stage_flat, front_out, mirror)
+            return self._contributions(preds, yc, stage_of)
+
+        def pair_body(carry, inp):
+            ce_tot, cc_tot = carry
+            xp, yp = inp  # leading dim 2: (normal, mirrored) chunks
+            for k, (stage_flat, mirror) in enumerate(
+                ((flat, False), (flipped, True))
+            ):
+                ce, cc = one_chunk(
+                    stage_flat, mirror, jax.tree.map(lambda a: a[k], xp), yp[k]
+                )
+                ce_tot = ce_tot + ce
+                cc_tot = cc_tot + cc
+            return (ce_tot, cc_tot), None
+
+        xs = jax.tree.map(
+            lambda a: a.reshape((self.config.times, 2) + tuple(a.shape[1:])), x
+        )
+        ys = y.reshape((self.config.times, 2) + tuple(y.shape[1:]))
+        zero = jnp.zeros((), jnp.float32)
+        (ce_tot, cc_tot), _ = lax.scan(pair_body, (zero, zero), (xs, ys))
         n_local = self.chunks * self.parts * self.mb_local
         return self._reduce_metrics(ce_tot, cc_tot, n_local)
 

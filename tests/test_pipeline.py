@@ -334,12 +334,14 @@ def test_mirror_pipeline_matches_golden():
     _run_and_compare(trainer)
 
 
-@pytest.mark.parametrize("times", [1, 2])
+@pytest.mark.parametrize("times", [1, 2, 4])
 def test_gems_master_matches_golden(times):
     """GEMS-MASTER: 2*times alternating normal/mirrored chunks with one
     parameter copy (mirror ppermute of stage rows) must equal the golden
     sequential pass over the same 2*times*B examples (ref
-    ``gems_master.py:72-103`` + allreduce merge ``comm.py:460-504``)."""
+    ``gems_master.py:72-103`` + allreduce merge ``comm.py:460-504``).
+    times=4 exercises the pair-scan chunk loop (compile cost flat in
+    ``--times``) beyond the scan's first two iterations."""
     cfg = ParallelConfig(
         batch_size=4, parts=2, split_size=2, spatial_size=0, image_size=32,
         times=times,
