@@ -89,21 +89,39 @@ def _eqn_flops(eqn) -> float:
     return 0.0
 
 
+def _subjaxprs(val):
+    """Yield every jaxpr reachable from one eqn param value: a bare jaxpr, a
+    ClosedJaxpr, or a tuple/list of either (``cond``'s ``branches``,
+    ``custom_*`` residuals). Misses would silently deflate the MFU
+    denominator (ADVICE r2), so unknown shapes fall through to zero yields
+    only when they genuinely hold no jaxpr."""
+    if hasattr(val, "eqns"):
+        yield val
+    elif hasattr(val, "jaxpr"):
+        yield val.jaxpr
+    elif isinstance(val, (tuple, list)):
+        for item in val:
+            yield from _subjaxprs(item)
+
+
 def _jaxpr_flops(jaxpr) -> float:
     total = 0.0
     for eqn in jaxpr.eqns:
         total += _eqn_flops(eqn)
-        # Recurse into call-like primitives (pjit, remat, custom_vjp, scan
-        # bodies × length, etc.).
+        # Recurse into every call-like primitive (pjit, remat, custom_vjp,
+        # scan bodies × length, cond/while branches, etc.). ``cond``
+        # branches: count the MAX branch — an upper bound that matches the
+        # convention of counting what the model would execute; for
+        # same-shape branches (the only use in this codebase's models: none
+        # today) the branches cost the same anyway.
         for name, val in eqn.params.items():
-            if name == "jaxpr" and hasattr(val, "eqns"):
-                inner = _jaxpr_flops(val)
-            elif name in ("jaxpr", "call_jaxpr", "fun_jaxpr") and hasattr(
-                val, "jaxpr"
-            ):
-                inner = _jaxpr_flops(val.jaxpr)
-            else:
+            subs = list(_subjaxprs(val))
+            if not subs:
                 continue
+            if name == "branches":
+                inner = max(_jaxpr_flops(j) for j in subs)
+            else:
+                inner = sum(_jaxpr_flops(j) for j in subs)
             if eqn.primitive.name == "scan":
                 inner *= eqn.params.get("length", 1)
             total += inner
@@ -123,6 +141,12 @@ def forward_flops(cells: Sequence[Any], x_shape, dtype=None) -> float:
 
     prev = os.environ.get("MPI4DL_TPU_CONV_IMPL")
     os.environ["MPI4DL_TPU_CONV_IMPL"] = "xla"
+    # Packed-layout cells execute MORE device FLOPs than the model math by
+    # design (scattered kernels), and PackedConv has no xla-impl escape —
+    # counting them would overstate MFU (ADVICE r2). PackedConv checks this
+    # env at trace time and raises, forcing callers to pass the logical
+    # (stock-layout) twin.
+    os.environ["MPI4DL_TPU_COUNTING_FLOPS"] = "1"
     try:
         # Init OUTSIDE the counted jaxpr (init traces each cell's forward,
         # which would triple-count every conv).
@@ -137,6 +161,7 @@ def forward_flops(cells: Sequence[Any], x_shape, dtype=None) -> float:
 
         jaxpr = jax.make_jaxpr(run)(params, x)
     finally:
+        os.environ.pop("MPI4DL_TPU_COUNTING_FLOPS", None)
         if prev is None:
             os.environ.pop("MPI4DL_TPU_CONV_IMPL", None)
         else:

@@ -432,12 +432,14 @@ def get_resnet_v2(
     layout="packed" builds the same model on the persistently-packed
     activation layout (ops/packed.py): identical parameter tree and math
     (mod f32 accumulation order), up to ~8x less HBM traffic for the
-    small-channel stages on TPU. Non-spatial only.
+    small-channel stages on TPU. Composes with ``spatial_cells`` — spatial
+    packed convs halo-exchange whole packed columns (``conv2d_packed``
+    spatial mode); the pack factor must divide each spatial stage's local
+    tile width (power-of-two tiles make this automatic for the standard
+    image sizes).
     """
     if layout not in ("nhwc", "packed"):
         raise ValueError(f"layout must be nhwc|packed, got {layout!r}")
-    if layout == "packed" and spatial_cells:
-        raise ValueError("packed layout does not compose with spatial cells yet")
     cells: list[nn.Module] = []
 
     def sp():

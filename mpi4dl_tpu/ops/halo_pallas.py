@@ -232,3 +232,26 @@ def default_impl() -> str:
     (``xla`` | ``pallas``), default ``xla`` (the Pallas path is opt-in until
     profiled on a real multi-chip slice)."""
     return os.environ.get("MPI4DL_TPU_HALO_IMPL", "xla").lower()
+
+
+def annotate_id_space_error(e: BaseException) -> None:
+    """Attach an operator hint to a compile error that looks like
+    collective-id-space exhaustion (ADVICE r2): with the Pallas halo impl,
+    ids are unique per trace by default, so a large spatial program
+    allocates hundreds of distinct ids — on a backend that bounds the id
+    space the first symptom is an opaque Mosaic compile failure. Trainers
+    call this before re-raising compile-time errors."""
+    if default_impl() != "pallas":
+        return
+    msg = str(e).lower()
+    if "collective" not in msg:
+        return
+    note = (
+        "hint: the Pallas halo kernel allocates one collective id per "
+        "exchange (unique per trace). If this backend bounds the "
+        "collective-id space, set MPI4DL_TPU_HALO_COLLECTIVE_IDS=<bound> "
+        "to cycle ids within it (safe: same-id exchanges are serialized "
+        "by layer dataflow), or MPI4DL_TPU_HALO_IMPL=xla to avoid Pallas."
+    )
+    if hasattr(e, "add_note"):  # py3.11+
+        e.add_note(note)

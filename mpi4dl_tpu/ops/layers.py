@@ -149,8 +149,15 @@ class Conv2d(nn.Module):
         if self.pack != (1, 1):
             # Persistently-packed activation layout (ops/packed.py): the
             # input is [B, H, W/pack_in, pack_in*C]; emit packed too.
+            # Spatial mode halo-exchanges whole packed columns (see
+            # conv2d_packed) — the D1 per-op exchange form only; the D2
+            # shrink form (exchange=False) has no packed variant.
+            if self.spatial and not self.exchange:
+                raise NotImplementedError(
+                    "packed layout has no D2 (pre-fetched halo) conv form"
+                )
             if self.spatial:
-                raise NotImplementedError("packed layout is non-spatial only")
+                _check_window_coverage(kh, kw, sh, sw, ph, pw)
             from mpi4dl_tpu.ops.packed import PackedConv
 
             return PackedConv(
@@ -161,6 +168,7 @@ class Conv2d(nn.Module):
                 strides=(sh, sw),
                 padding=((ph, ph), (pw, pw)),
                 use_bias=self.use_bias,
+                spatial=self.spatial,
                 dtype=self.dtype,
                 name="conv",
             )(x)

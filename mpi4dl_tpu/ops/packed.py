@@ -122,10 +122,28 @@ def packed_kernel(w, f_in: int, f_out: int, s: int, pw: int):
     return kp, s_p, pl_p
 
 
-def conv2d_packed(xp, w, f_in: int, f_out: int, strides, padding):
+def conv2d_packed(
+    xp,
+    w,
+    f_in: int,
+    f_out: int,
+    strides,
+    padding,
+    spatial: bool = False,
+):
     """Logical conv on packed operands. xp [B, H, W/f_in, f_in*C];
     w [kh, kw, C, O] (logical params); strides (sh, sw) with sh == sw;
     padding ((ph, ph), (pw, pw)) logical. Returns [B, H', W'/f_out, f_out*O].
+
+    ``spatial=True`` (inside ``shard_map`` over the tile mesh axes) replaces
+    the zero padding with a halo exchange — ref ``conv_spatial``
+    (``spatial.py:25-1029``) on the packed layout. The exchange moves WHOLE
+    packed columns: a packed column is bit-identical memory to ``f_in``
+    logical columns, so the neighbor's edge column block carries exactly the
+    logical halo (plus up to ``f_in - pw`` extra columns that the scattered
+    kernel's zero taps ignore), and ``ppermute``'s zero fill at the mesh
+    boundary reproduces ``ZeroPad2d`` semantics — the packed conv's masked
+    taps never read past the logical pad width.
     """
     sh, sw = strides
     (ph0, ph1), (pw0, pw1) = padding
@@ -133,6 +151,39 @@ def conv2d_packed(xp, w, f_in: int, f_out: int, strides, padding):
     kh, kw = w.shape[0], w.shape[1]
     kp, s_p, pl_p = packed_kernel(w, f_in, f_out, sw, pw0)
     win_p = xp.shape[2]
+
+    if spatial:
+        from mpi4dl_tpu.parallel.halo import halo_exchange
+
+        assert ph0 == ph1, "packed spatial conv needs symmetric H padding"
+        if (win_p * f_in) % (sw * f_out):
+            raise ValueError(
+                f"packed spatial conv: local width {win_p * f_in} must "
+                f"divide by stride*f_out={sw * f_out}"
+            )
+        wout_p = win_p * f_in // (sw * f_out)  # this tile's output columns
+        # Column halo wide enough for both the plan's left pad and the
+        # rightmost window; off realigns the VALID output grid when the
+        # exchange is wider than the plan's left pad.
+        pr_p = s_p * (wout_p - 1) + kp.shape[1] - pl_p - win_p
+        hw_p = max(pl_p, pr_p, 0)
+        off, rem = divmod(hw_p - pl_p, s_p)
+        if rem:
+            raise ValueError(
+                "packed spatial conv: halo width misaligned with the packed "
+                f"stride (pl'={pl_p}, pr'={pr_p}, s'={s_p})"
+            )
+        h_loc = xp.shape[1]
+        xe = halo_exchange(xp, ph0, hw_p)
+        y = lax.conv_general_dilated(
+            xe,
+            kp,
+            (sh, s_p),
+            ((0, 0), (0, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y[:, : h_loc // sh, off : off + wout_p, :]
+
     w_logical = win_p * f_in
     w_out = (w_logical + 2 * pw0 - kw) // sw + 1
     if w_out % f_out:
@@ -165,10 +216,19 @@ class PackedConv(nn.Module):
     strides: tuple[int, int] = (1, 1)
     padding: tuple[tuple[int, int], tuple[int, int]] = ((0, 0), (0, 0))
     use_bias: bool = True
+    spatial: bool = False  # halo-exchange instead of zero pad (shard_map)
     dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
+        import os
+
+        if os.environ.get("MPI4DL_TPU_COUNTING_FLOPS"):
+            raise ValueError(
+                "MFU FLOPs must be counted on the logical (stock-layout) "
+                "model: PackedConv executes inflated scattered-kernel FLOPs "
+                "by design (see mpi4dl_tpu/flops.py)"
+            )
         kh, kw = self.kernel_size
         c_in = x.shape[-1] // self.pack_in
         kernel = self.param(
@@ -186,7 +246,8 @@ class PackedConv(nn.Module):
         )
         x, kernel, bias = nn.dtypes.promote_dtype(x, kernel, bias, dtype=self.dtype)
         y = conv2d_packed(
-            x, kernel, self.pack_in, self.pack_out, self.strides, self.padding
+            x, kernel, self.pack_in, self.pack_out, self.strides, self.padding,
+            spatial=self.spatial,
         )
         if bias is not None:
             y = y + jnp.tile(bias, self.pack_out)

@@ -550,7 +550,9 @@ class PipelineTrainer:
         )
 
     def train_step(self, state: TrainState, x, y):
-        return self._jit_step(state, x, y)
+        from mpi4dl_tpu.train import call_with_halo_hint
+
+        return call_with_halo_hint(self._jit_step, state, x, y)
 
     def shard_batch(self, x, y):
         """[B, H, W, C] → micro-batched [parts, mb, H, W, C] placed on the

@@ -411,7 +411,21 @@ class Trainer:
         return put_global(self.mesh, (self.x_spec, self.y_spec), x, y)
 
     def train_step(self, state: TrainState, x, y):
-        return self._jit_step(state, x, y)
+        return call_with_halo_hint(self._jit_step, state, x, y)
+
+
+def call_with_halo_hint(fn, *args):
+    """Invoke a jitted step, annotating compile errors that look like
+    Pallas collective-id-space exhaustion with the operator hint
+    (:func:`mpi4dl_tpu.ops.halo_pallas.annotate_id_space_error`). Shared by
+    both trainers so the caught-type/hint logic cannot drift."""
+    try:
+        return fn(*args)
+    except jax.errors.JaxRuntimeError as e:
+        from mpi4dl_tpu.ops.halo_pallas import annotate_id_space_error
+
+        annotate_id_space_error(e)  # operator hint; no-op off-pallas
+        raise
 
 
 def single_device_step(cells: Sequence[Any], learning_rate=0.001, momentum=0.9, parts=1):

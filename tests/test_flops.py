@@ -39,3 +39,37 @@ def test_resnet_train_flops_sane():
 
 def test_mfu_none_off_tpu():
     assert mfu(10.0, 1e12) is None  # CPU test process: unknown peak
+
+
+def test_flops_counted_inside_cond_branches():
+    """FLOPs inside lax.cond branches must be counted (ADVICE r2: the
+    recursion previously skipped the 'branches' tuple-of-jaxprs param,
+    silently deflating the MFU denominator)."""
+    import flax.linen as nn
+    import jax
+
+    class CondCell(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            w = self.param(
+                "w", nn.initializers.ones_init(), (x.shape[-1], 16), jnp.float32
+            )
+            return jax.lax.cond(
+                x.sum() > 0, lambda: x @ w, lambda: (x * 2) @ w
+            )
+
+    got = forward_flops([CondCell()], (1, 4, 4, 8))
+    want = 2 * 4 * 4 * 16 * 8  # one branch's matmul (max over branches)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_forward_flops_rejects_packed_cells():
+    """MFU must be counted on the logical model: packed cells execute
+    inflated scattered-kernel FLOPs and are rejected at trace time."""
+    import pytest
+
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+
+    packed = get_resnet_v2(depth=20, layout="packed")
+    with pytest.raises(ValueError, match="logical"):
+        forward_flops(packed, (1, 32, 32, 3))

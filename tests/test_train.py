@@ -288,3 +288,45 @@ def test_packed_layout_matches_golden(remat):
         )
         x, y = _batch(b=4, size=32, seed=seed + 30)
     _assert_tree_close(state.params, golden_state.params, rtol=5e-3, atol=1e-4)
+
+
+def test_packed_spatial_matches_golden():
+    """Packed layout under spatial partitioning (round-2 VERDICT #4): the
+    packed conv's zero-pad columns become halo-exchanged packed columns
+    (``conv2d_packed`` spatial mode) — the distributed packed train step
+    must match the single-device stock-NHWC golden like the plain spatial
+    trainer does."""
+
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+
+    kw = dict(depth=20, num_classes=10, pool_kernel=8)
+    plain = get_resnet_v2(**kw)
+    n_sp = len(plain) - 1  # every cell but the head runs on 2x2 tiles
+    packed_sp = get_resnet_v2(layout="packed", spatial_cells=n_sp, **kw)
+    cfg = ParallelConfig(
+        batch_size=4,
+        split_size=1,
+        spatial_size=1,
+        num_spatial_parts=(4,),
+        slice_method="square",
+        image_size=32,
+    )
+    trainer = Trainer(
+        packed_sp, num_spatial_cells=n_sp, config=cfg, plain_cells=plain
+    )
+    state = trainer.init(jax.random.PRNGKey(7), (4, 32, 32, 3))
+    _, golden_step = single_device_step(plain)
+    gp = jax.tree.map(jnp.copy, state.params)
+    golden_state = TrainState(
+        params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+    )
+    x, y = _batch(b=4, size=32)
+    for seed in (1, 2):
+        xs, ys = trainer.shard_batch(x, y)
+        state, metrics = trainer.train_step(state, xs, ys)
+        golden_state, golden_metrics = golden_step(golden_state, x, y)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-4
+        )
+        x, y = _batch(b=4, size=32, seed=seed + 30)
+    _assert_tree_close(state.params, golden_state.params, rtol=5e-3, atol=1e-4)
