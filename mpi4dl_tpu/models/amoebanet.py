@@ -441,12 +441,14 @@ class PoolD2(nn.Module):
 
         from mpi4dl_tpu.parallel.halo import fill_boundary_halo, zero_boundary_halo
 
+        from mpi4dl_tpu.ops.layers import max_pool_s1_valid
+
         h = self.halo_in
         if h < 1:
             raise ValueError("PoolD2 needs halo_in >= 1 (3x3 pad-1 window)")
         if self.kind == "max":
             x = fill_boundary_halo(x, h, h, float("-inf"))
-            return nn.max_pool(x, (3, 3), strides=(1, 1), padding="VALID")
+            return max_pool_s1_valid(x, 3, 3)
         if self.kind != "avg":
             raise ValueError(f"unknown pool kind {self.kind!r}")
         x = zero_boundary_halo(x, h, h)

@@ -201,6 +201,28 @@ class Conv2d(nn.Module):
         return conv(x)
 
 
+def max_pool_s1_valid(x, kh: int, kw: int):
+    """Stride-1 VALID max pool as a tree of shifted ``jnp.maximum``s.
+
+    Numerically identical forward to ``lax.reduce_window(max)``, but the
+    backward lowers to selects + pads instead of ``select_and_scatter`` —
+    measured 17% of the AmoebaNet train step on TPU (docs/PERF.md round 3);
+    the genotype runs a 3×3 s1 max pool in every cell. Tie-breaking of the
+    gradient differs from ``select_and_scatter`` (maximum-chain subgradients
+    vs first-window-element); every model path (plain, spatial, D2) uses
+    THIS implementation for stride-1 pools, so golden comparisons are
+    impl-consistent, like the reference's CUDA pooling is with itself.
+    """
+    b, h, w, c = x.shape
+    oh, ow = h - kh + 1, w - kw + 1
+    y = None
+    for u in range(kh):
+        for v in range(kw):
+            s = lax.slice(x, (0, u, v, 0), (b, u + oh, v + ow, c))
+            y = s if y is None else jnp.maximum(y, s)
+    return y
+
+
 class Pool(nn.Module):
     """Max/avg pooling, optionally with halo exchange (ref ``Pool``,
     ``spatial.py:1416-1509``).
@@ -255,7 +277,18 @@ class Pool(nn.Module):
             pad = ((ph, ph), (pw, pw))
 
         if self.kind == "max":
-            y = nn.max_pool(x, (kh, kw), strides=(sh, sw), padding=pad)
+            if (sh, sw) == (1, 1):
+                # Stride-1: shifted-maximum decomposition (cheap backward;
+                # see max_pool_s1_valid). -inf edge pad == torch MaxPool2d.
+                if pad != ((0, 0), (0, 0)):
+                    x = lax.pad(
+                        x,
+                        jnp.asarray(float("-inf"), x.dtype),
+                        ((0, 0, 0), (*pad[0], 0), (*pad[1], 0), (0, 0, 0)),
+                    )
+                y = max_pool_s1_valid(x, kh, kw)
+            else:
+                y = nn.max_pool(x, (kh, kw), strides=(sh, sw), padding=pad)
         elif self.kind == "avg":
             y = nn.avg_pool(
                 x,

@@ -25,6 +25,7 @@ reference's double softmax — see ``models/resnet.py`` docstring).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Sequence
 
 import jax
@@ -314,7 +315,13 @@ class Trainer:
             def body(hc, p):
                 return ckpt(apply_compact)(p, hc), None
 
-            hc, _ = lax.scan(body, hc, stacked)
+            # Unrolling amortizes the scan machinery (parameter
+            # dynamic-slices, carry copies — measured ~12% of the AmoebaNet
+            # step, docs/PERF.md round 3) at the cost of a proportionally
+            # bigger program; 1 = off (the safe default for the compile-
+            # helper-limited runtime).
+            unroll = int(os.environ.get("MPI4DL_TPU_SCAN_UNROLL", "1"))
+            hc, _ = lax.scan(body, hc, stacked, unroll=unroll)
             h = self._restore(hc, shapes)
         return h
 

@@ -82,6 +82,10 @@ def report(out_dir, top=30):
     cols = [c["id"] for c in tbl["cols"]]
     rows = [dict(zip(cols, [c["v"] for c in r["c"]])) for r in tbl["rows"]]
     dev = [r for r in rows if r.get("host_or_device") == "Device"]
+    # xprof renamed self_time -> total_self_time across versions; take either.
+    key = "self_time" if (dev and "self_time" in dev[0]) else "total_self_time"
+    for r in dev:
+        r["self_time"] = r[key]
     total = sum(r["self_time"] for r in dev)
     print(f"total device self_time: {total / 1e3:.2f} ms (all captured steps)")
     by_type = {}
