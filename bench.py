@@ -160,9 +160,11 @@ def main():
     _budget()  # a malformed BENCH_TIME_BUDGET must fail before, not after,
     # the headline measurement pays its multi-minute compile
 
-    from mpi4dl_tpu.utils import apply_platform_env
+    from mpi4dl_tpu.utils import apply_platform_env, enable_compilation_cache
 
     apply_platform_env()  # honor JAX_PLATFORMS even under the axon plugin
+    enable_compilation_cache()  # warm-cache compiles make the suite fit any
+    # driver budget (first-ever run still pays them; the budget skips extras)
 
     import jax
     import jax.numpy as jnp
@@ -201,6 +203,13 @@ def main():
     # bodies) compiles fine.
     remats = [remat_pref] if remat_pref else ["cell_save", "scan_save", "scan"]
     amoeba_remats = [remat_pref] if remat_pref else ["scan_save", "scan"]
+    # >=2048px: cell_save/scan_save reproducibly kill the remote-compile
+    # helper (PERF.md r3 #1) — paying those failed compiles (~minutes each)
+    # on every run wastes the driver's budget; start at the policy that fits.
+    big_remats = [remat_pref] if remat_pref else ["scan"]
+
+    def remats_for(size, base):
+        return base if size < 2048 else big_remats
 
     extras: dict = {}
     # Packed activation layout (ops/packed.py): measured win on TPU;
@@ -216,7 +225,9 @@ def main():
             depth=depth, num_classes=10, pool_kernel=size // 4,
             layout=layout, dtype=dtype,
         )
-        ips, remat = _train_throughput(cells, size, b, steps, warmup, dtype, remats)
+        ips, remat = _train_throughput(
+            cells, size, b, steps, warmup, dtype, remats_for(size, remats)
+        )
         logical = get_resnet_v2(
             depth=depth, num_classes=10, pool_kernel=size // 4, dtype=dtype
         )
@@ -299,7 +310,8 @@ def main():
                     dtype=dtype,
                 )
                 ips, remat = _train_throughput(
-                    cells, size, b, steps, warmup, dtype, amoeba_remats
+                    cells, size, b, steps, warmup, dtype,
+                    remats_for(size, amoeba_remats),
                 )
                 util = mfu(
                     ips, train_flops_per_image(cells, size, dtype),
@@ -320,6 +332,57 @@ def main():
                 amoeba,
                 est_seconds=30.0 if on_cpu else (600.0 if size >= 2048 else 400.0),
             )
+
+    if which in ("resnet", "all") and not on_cpu:
+        def peak_px():
+            # BASELINE.json capability metric: largest square resolution
+            # whose full train step (fwd+bwd+update) fits ONE chip, bs=1 —
+            # the single-chip floor of the "SP trains resolutions DP can't"
+            # story (scripts/peak_pixels.py is the standalone walker).
+            # Each size's success is recorded + emitted IMMEDIATELY: the
+            # next (larger) attempt is expected to eventually fail, and a
+            # wedged compile or budget kill must not erase a measured peak.
+            entry = {
+                "peak_trainable_px_per_chip": None,
+                "img_per_sec_at_peak": None,
+                "unit": "square image side, bs=1, one chip",
+            }
+
+            def record(size, ips, note=None):
+                if size is not None:
+                    entry["peak_trainable_px_per_chip"] = size
+                    entry["img_per_sec_at_peak"] = ips
+                if note:
+                    entry["stopped_by"] = note
+                extras["resnet_peak_pixels"] = entry
+                _RESULT["extras"] = extras
+                if _RESULT.get("metric"):
+                    _emit()
+
+            prior = extras.get("resnet110_2048px_bs1", {})
+            if prior.get("value") is not None:
+                record(2048, prior["value"])
+            for size in (4096, 8192):
+                if _remaining() < 500:
+                    record(None, None, f"{size}: budget exhausted before attempt")
+                    break
+                cells = get_resnet_v2(
+                    depth=get_depth(2, 12), num_classes=10,
+                    pool_kernel=size // 4, layout=layout, dtype=dtype,
+                )
+                try:
+                    # big_remats: the only policies that fit >=2048px
+                    # (PERF.md r3); honors a BENCH_REMAT override.
+                    ips, _ = _train_throughput(
+                        cells, size, 1, 3, 1, dtype, big_remats
+                    )
+                except Exception as e:  # noqa: BLE001 — walk stops here
+                    record(None, None, f"{size}: {type(e).__name__}: {str(e)[:120]}")
+                    break
+                record(size, round(ips, 3))
+            return entry
+
+        run_extra("resnet_peak_pixels", peak_px, est_seconds=500.0)
 
     if _RESULT.get("value") is None:
         # ADVICE r2: an all-failure run must say so explicitly, not hand

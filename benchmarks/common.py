@@ -36,7 +36,9 @@ def build_config(args, spatial: bool, num_cells: int | None = None):
 
     from mpi4dl_tpu.config import ParallelConfig
     from mpi4dl_tpu.parallel import multihost
+    from mpi4dl_tpu.utils import enable_compilation_cache
 
+    enable_compilation_cache()  # multi-minute XLA compiles amortize across runs
     # Join the multi-host world if one is configured (no-op single-process;
     # the reference's dist.init_process_group moment, comm.py:154-159).
     multihost.initialize_distributed()

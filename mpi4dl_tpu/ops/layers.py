@@ -213,13 +213,18 @@ def max_pool_s1_valid(x, kh: int, kw: int):
     THIS implementation for stride-1 pools, so golden comparisons are
     impl-consistent, like the reference's CUDA pooling is with itself.
     """
-    b, h, w, c = x.shape
-    oh, ow = h - kh + 1, w - kw + 1
+    h, w = x.shape[1], x.shape[2]
+    # Separable: max over rows, then cols (associativity makes the forward
+    # identical to the 2-D window) — kh+kw maximum ops instead of kh*kw, and
+    # the backward's select/accumulate chain shrinks proportionally.
     y = None
     for u in range(kh):
-        for v in range(kw):
-            s = lax.slice(x, (0, u, v, 0), (b, u + oh, v + ow, c))
-            y = s if y is None else jnp.maximum(y, s)
+        s = lax.slice_in_dim(x, u, u + h - kh + 1, axis=1)
+        y = s if y is None else jnp.maximum(y, s)
+    x, y = y, None
+    for v in range(kw):
+        s = lax.slice_in_dim(x, v, v + w - kw + 1, axis=2)
+        y = s if y is None else jnp.maximum(y, s)
     return y
 
 

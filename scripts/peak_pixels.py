@@ -24,9 +24,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def try_size(model: str, size: int, batch: int, remats) -> tuple[float, str] | str:
     import numpy as np
 
-    from mpi4dl_tpu.utils import apply_platform_env
+    from mpi4dl_tpu.utils import apply_platform_env, enable_compilation_cache
 
     apply_platform_env()
+    enable_compilation_cache()
     import jax
     import jax.numpy as jnp
 

@@ -158,6 +158,15 @@ def test_remat_policies_match_golden(remat):
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
 
 
+def test_scan_unroll_matches_golden(monkeypatch):
+    """MPI4DL_TPU_SCAN_UNROLL amortizes scan machinery without changing
+    numerics: an unrolled scan run must equal the no-remat golden exactly
+    like unroll=1 does (unroll=2 on a 3-cell run also covers the remainder
+    handling)."""
+    monkeypatch.setenv("MPI4DL_TPU_SCAN_UNROLL", "2")
+    test_remat_policies_match_golden("scan_save")
+
+
 def test_scan_remat_spatial_matches_golden():
     """The "scan" policy composes with a spatial front: runs never span the
     SP→LP join and spatial (halo-exchanging) repeated cells scan inside
