@@ -1,0 +1,170 @@
+"""Eval / inference path: BN calibration + frozen-statistics evaluation.
+
+The reference framework never evaluates — its benchmarks train only, and
+its BatchNorm running buffers are written but never read (there is no eval
+or inference entry point anywhere under ``/root/reference/benchmarks``).
+This module supplies the missing inference story in the TPU-idiomatic way:
+
+1. **Calibration pass** (:func:`collect_batch_stats`): run a few training
+   batches through the model under ``bn_stats_mode("collect")``, summing
+   each BN site's per-batch moments into a ``batch_stats`` collection.
+   With equal-size batches the averaged moments are the EXACT pooled
+   statistics of the calibration set (mean of per-batch E[x] / E[x²] over
+   equal counts == pooled E[x] / E[x²]) — no EMA decay error, and the
+   train step stays pure (params-only, donated buffers) instead of
+   threading mutable state through every trainer/pipeline/GEMS path.
+   This is the BN re-estimation recipe used in stochastic-weight-averaging
+   practice, and it is *more* faithful than torch's momentum-EMA buffers.
+
+2. **Frozen-stats evaluation** (:func:`make_eval_step` / :func:`evaluate`):
+   apply the model under ``bn_stats_mode("running")`` with the calibrated
+   ``{mean, var}`` per BN site. Deterministic, batch-size independent.
+
+Works with any cell list whose BNs are :class:`~mpi4dl_tpu.ops.layers.
+TrainBatchNorm` or ``PackedTrainBatchNorm`` — i.e. every model the zoo
+builds, in stock or packed layout. Evaluate on the *plain* twin of a
+spatial model (identical parameter structure — ``partition.init_cells``):
+inference has no reason to pay halo exchanges.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections.abc import Mapping
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from mpi4dl_tpu.ops.layers import bn_stats_mode
+from mpi4dl_tpu.train import correct_count, cross_entropy_sum
+
+_STAT_KEYS = frozenset({"count", "mean_sum", "mean_sq_sum"})
+
+
+def _finalize(tree):
+    """Convert accumulated {count, mean_sum, mean_sq_sum} leaf groups into
+    the frozen {mean, var} stats the "running" mode reads."""
+    if isinstance(tree, Mapping):
+        if _STAT_KEYS.issubset(tree.keys()):
+            n = tree["count"]
+            mean = tree["mean_sum"] / n
+            var = tree["mean_sq_sum"] / n - jnp.square(mean)
+            return {"mean": mean, "var": var}
+        return {k: _finalize(v) for k, v in tree.items()}
+    return tree
+
+
+def collect_batch_stats(
+    cells: Sequence[Any], params: Sequence[Any], batches
+) -> list:
+    """Exact pooled BN statistics over ``batches`` (iterable of input
+    arrays, all the same shape). Returns one ``batch_stats`` dict per cell
+    (``{}`` for cells with no BN), ready for :func:`make_eval_step`."""
+
+    def one_batch(params, stats, x):
+        with bn_stats_mode("collect"):
+            out = []
+            for cell, p, s in zip(cells, params, stats):
+                variables = dict(p)
+                if s:
+                    variables["batch_stats"] = s
+                x, upd = cell.apply(variables, x, mutable=["batch_stats"])
+                out.append(upd.get("batch_stats", {}))
+            return stats_unfreeze(out), x
+
+    # Two traces total: the first batch initializes the collection (stats
+    # arg is all-empty), later batches thread the accumulated structure.
+    first = jax.jit(lambda p, x: one_batch(p, [{}] * len(cells), x)[0])
+    rest = jax.jit(lambda p, s, x: one_batch(p, s, x)[0])
+
+    stats = shape = None
+    for x in batches:
+        if shape is None:
+            shape = x.shape
+        elif x.shape != shape:
+            # Unequal batches would be weighted equally, silently breaking
+            # the exact-pooled-statistics guarantee — refuse instead (drop
+            # or pad the trailing partial batch upstream).
+            raise ValueError(
+                f"calibration batches must share one shape for exact pooled "
+                f"stats; got {shape} then {x.shape}"
+            )
+        stats = first(params, x) if stats is None else rest(params, stats, x)
+    if stats is None:
+        raise ValueError("collect_batch_stats needs at least one batch")
+    return [_finalize(s) for s in stats]
+
+
+def stats_unfreeze(stats):
+    """Plain-dict view (flax may hand back FrozenDicts from ``mutable``)."""
+    return [
+        s.unfreeze() if hasattr(s, "unfreeze") else dict(s) for s in stats
+    ]
+
+
+def _apply_running(cells, params, batch_stats, x):
+    with bn_stats_mode("running"):
+        for cell, p, s in zip(cells, params, batch_stats):
+            variables = dict(p)
+            if s:
+                variables["batch_stats"] = s
+            x = cell.apply(variables, x)
+    return x
+
+
+# Memoized per cell tuple (flax modules are frozen/hashable): a trainer
+# that evaluates every N steps must reuse ONE jitted callable, not retrace
+# the full model per evaluate() call.
+@functools.lru_cache(maxsize=None)
+def _predict_for(cells: tuple):
+    return jax.jit(
+        lambda params, batch_stats, x: _apply_running(
+            cells, params, batch_stats, x
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _eval_step_for(cells: tuple):
+    def step(params, batch_stats, x, y):
+        logits = _apply_running(cells, params, batch_stats, x)
+        return {
+            "loss": cross_entropy_sum(logits, y) / x.shape[0],
+            "correct": correct_count(logits, y),
+        }
+
+    return jax.jit(step)
+
+
+def make_predict(cells: Sequence[Any]):
+    """Jitted ``(params, batch_stats, x) -> logits`` with frozen BN stats."""
+    return _predict_for(tuple(cells))
+
+
+def make_eval_step(cells: Sequence[Any]):
+    """Jitted ``(params, batch_stats, x, y) -> {"loss", "correct"}``.
+    loss = mean CE over the batch; correct = count of argmax hits."""
+    return _eval_step_for(tuple(cells))
+
+
+def evaluate(
+    cells: Sequence[Any], params: Sequence[Any], batch_stats, batches
+) -> dict:
+    """Aggregate loss/accuracy over an iterable of ``(x, y)`` batches."""
+    step = make_eval_step(cells)
+    total = correct = 0
+    loss_sum = 0.0
+    for x, y in batches:
+        m = step(params, batch_stats, x, y)
+        b = x.shape[0]
+        loss_sum += float(m["loss"]) * b
+        correct += int(m["correct"])
+        total += b
+    if total == 0:
+        raise ValueError("evaluate needs at least one batch")
+    return {
+        "loss": loss_sum / total,
+        "accuracy": correct / total,
+        "count": total,
+    }

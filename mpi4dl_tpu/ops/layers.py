@@ -18,8 +18,10 @@ Semantics parity notes:
   reference's per-tile BN behavior under SP. With mesh axis names, stats are
   ``pmean``-ed across tiles (cross-tile BN) which restores bit-parity with a
   single-device golden model; this is what the spatial model builders use by
-  default. Running-average stats for eval are intentionally not tracked yet
-  (the reference never reads them either — no eval / checkpoint path).
+  default. Eval-time stats come from a *calibration pass* rather than EMA
+  buffers mutated inside the train step (which stays pure/donated): see
+  :func:`bn_stats_mode` and :mod:`mpi4dl_tpu.evaluate`. (The reference has
+  no eval path at all — its BN buffers are written but never read.)
 - ``Pool(spatial=True)`` == ref ``Pool`` (``spatial.py:1416-1509``): halo
   exchange of ``padding`` rows/cols, then VALID pooling.
 - ``HaloExchange`` == ref ``halo_exchange_layer`` (``spatial.py:1032-1413``),
@@ -28,6 +30,7 @@ Semantics parity notes:
 
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Any, Callable, Sequence
 
@@ -63,12 +66,48 @@ def _check_window_coverage(kh, kw, sh, sw, ph, pw):
         )
 
 
+# --- BN statistics mode -----------------------------------------------------
+# Trace-time switch read by TrainBatchNorm/PackedTrainBatchNorm. "batch"
+# (the default) declares NO extra variables, so the train step's params-only
+# plumbing is untouched. "collect" accumulates exact pooled statistics into
+# a mutable "batch_stats" collection (a calibration pass — cf. BN
+# re-estimation practice); "running" normalizes with frozen {mean, var} from
+# that collection (inference). A plain global rather than a module field so
+# no model builder, cell class, or trainer needs a new knob; each
+# mode-specific callable is traced exactly once under its own mode
+# (mpi4dl_tpu/evaluate.py), so jit caching never crosses modes.
+_BN_MODE = ["batch"]
+
+
+def current_bn_mode() -> str:
+    return _BN_MODE[0]
+
+
+@contextlib.contextmanager
+def bn_stats_mode(mode: str):
+    """Trace the enclosed model application in the given BN mode
+    ("batch" | "collect" | "running"). See module docstring."""
+    if mode not in ("batch", "collect", "running"):
+        raise ValueError(f"bn mode must be batch|collect|running, got {mode!r}")
+    prev = _BN_MODE[0]
+    _BN_MODE[0] = mode
+    try:
+        yield
+    finally:
+        _BN_MODE[0] = prev
+
+
 class TrainBatchNorm(nn.Module):
     """Batch normalization using current-batch statistics.
 
     reduce_axes: mesh axis names to average statistics over (cross-tile BN
     under spatial partitioning). Empty → local statistics (torch
     ``BatchNorm2d`` training-mode parity per device/tile).
+
+    Under ``bn_stats_mode("collect")`` the (cross-tile-reduced) per-batch
+    moments are additionally summed into a ``batch_stats`` collection;
+    under ``bn_stats_mode("running")`` frozen ``{mean, var}`` stats from
+    that collection replace the batch statistics (eval / inference).
     """
 
     eps: float = 1e-5
@@ -81,6 +120,16 @@ class TrainBatchNorm(nn.Module):
         c = x.shape[-1]
         scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
+        if current_bn_mode() == "running":
+            mean = self.variable(
+                "batch_stats", "mean", jnp.zeros, (c,), jnp.float32
+            ).value
+            var = self.variable(
+                "batch_stats", "var", jnp.ones, (c,), jnp.float32
+            ).value
+            w = (lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
+            b = (bias - mean * lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
+            return x * w + b
         # D2 fused-halo tiles carry `interior` rows/cols of neighbor data;
         # excluding them from the statistics makes cross-tile (pmean) stats
         # bit-identical to the plain model's — a correctness refinement over
@@ -104,10 +153,26 @@ class TrainBatchNorm(nn.Module):
         if self.reduce_axes:
             mean = lax.pmean(mean, self.reduce_axes)
             mean_sq = lax.pmean(mean_sq, self.reduce_axes)
+        if current_bn_mode() == "collect":
+            _accumulate_bn_stats(self, mean, mean_sq)
         var = mean_sq - jnp.square(mean)
         w = (lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
         b = (bias - mean * lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
         return x * w + b
+
+
+def _accumulate_bn_stats(mod: nn.Module, mean, mean_sq) -> None:
+    """Sum this batch's (cross-tile-reduced) moments into the module's
+    ``batch_stats`` collection. Equal-size calibration batches make the
+    averaged moments EXACT pooled statistics (mean of per-batch E[x] and
+    E[x²] over equal counts = pooled E[x] / E[x²]) — no EMA decay error."""
+    c = mean.shape
+    cnt = mod.variable("batch_stats", "count", jnp.zeros, (), jnp.float32)
+    ms = mod.variable("batch_stats", "mean_sum", jnp.zeros, c, jnp.float32)
+    mq = mod.variable("batch_stats", "mean_sq_sum", jnp.zeros, c, jnp.float32)
+    cnt.value = cnt.value + 1.0
+    ms.value = ms.value + mean
+    mq.value = mq.value + mean_sq
 
 
 class Conv2d(nn.Module):

@@ -273,10 +273,24 @@ class PackedTrainBatchNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from mpi4dl_tpu.ops.layers import _accumulate_bn_stats, current_bn_mode
+
         fc = x.shape[-1]
         c = fc // self.pack
         scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
+        if current_bn_mode() == "running":
+            # Frozen calibration stats (mpi4dl_tpu/evaluate.py) — logical
+            # [C], tiled over the subpixel axis like w/b below.
+            mean = self.variable(
+                "batch_stats", "mean", jnp.zeros, (c,), jnp.float32
+            ).value
+            var = self.variable(
+                "batch_stats", "var", jnp.ones, (c,), jnp.float32
+            ).value
+            w = (lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
+            b = (bias - mean * lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
+            return x * jnp.tile(w, self.pack) + jnp.tile(b, self.pack)
         red = tuple(range(x.ndim - 1))
         n = math.prod(x.shape[a] for a in red) * self.pack
         ssum = jnp.sum(x, red, dtype=jnp.float32).reshape(self.pack, c)
@@ -286,6 +300,8 @@ class PackedTrainBatchNorm(nn.Module):
         if self.reduce_axes:
             mean = lax.pmean(mean, self.reduce_axes)
             mean_sq = lax.pmean(mean_sq, self.reduce_axes)
+        if current_bn_mode() == "collect":
+            _accumulate_bn_stats(self, mean, mean_sq)
         var = mean_sq - jnp.square(mean)
         w = (lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
         b = (bias - mean * lax.rsqrt(var + self.eps) * scale).astype(x.dtype)

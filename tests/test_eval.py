@@ -1,0 +1,123 @@
+"""Eval/inference path: BN calibration + frozen-stats evaluation.
+
+The reference never evaluates (no eval entry point; BN buffers written,
+never read) — this is a capability addition, so the goldens here are
+self-referential: (1) moments pooled over the calibration set are exact,
+(2) running mode with stats from exactly ONE batch reproduces the
+train-mode forward on that batch, (3) the default mode stays "batch" so
+the training path is provably untouched.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.evaluate import (
+    collect_batch_stats,
+    evaluate,
+    make_eval_step,
+    make_predict,
+)
+from mpi4dl_tpu.models.resnet import get_resnet_v2
+from mpi4dl_tpu.ops.layers import TrainBatchNorm, bn_stats_mode, current_bn_mode
+from mpi4dl_tpu.parallel.partition import init_cells
+from mpi4dl_tpu.train import apply_cells
+from mpi4dl_tpu.utils import get_depth
+
+
+def _batches(n, shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.standard_normal(shape), jnp.float32) for _ in range(n)
+    ]
+
+
+def _tiny_resnet(layout=None):
+    kwargs = {"layout": layout} if layout else {}
+    return get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=8, **kwargs
+    )
+
+
+def test_bn_mode_default_and_restore():
+    assert current_bn_mode() == "batch"
+    with bn_stats_mode("collect"):
+        assert current_bn_mode() == "collect"
+    assert current_bn_mode() == "batch"
+    with pytest.raises(ValueError):
+        with bn_stats_mode("nope"):
+            pass
+
+
+def test_collected_stats_are_exact_pooled_moments():
+    # One bare BN module: the calibrated {mean, var} must equal the
+    # analytic moments of the concatenated calibration set.
+    bn = TrainBatchNorm()
+    xs = _batches(3, (2, 4, 4, 5))
+    params = bn.init(jax.random.PRNGKey(0), xs[0])
+    stats = collect_batch_stats([bn], [params], xs)[0]
+    allx = np.concatenate([np.asarray(x) for x in xs], axis=0)
+    want_mean = allx.reshape(-1, 5).mean(0)
+    want_var = allx.reshape(-1, 5).var(0)
+    np.testing.assert_allclose(np.asarray(stats["mean"]), want_mean, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["var"]), want_var, atol=1e-5)
+
+
+@pytest.mark.parametrize("layout", [None, "packed"])
+def test_single_batch_calibration_reproduces_train_forward(layout):
+    # Stats collected from exactly one batch == that batch's statistics,
+    # so running mode must reproduce the train-mode forward bit-near-exactly
+    # — covering every BN site of a real model (incl. PackedTrainBatchNorm).
+    cells = _tiny_resnet(layout)
+    x = _batches(1, (2, 32, 32, 3))[0]
+    params = init_cells(
+        _tiny_resnet(None), jax.random.PRNGKey(1), jnp.zeros_like(x)
+    )
+    train_out = apply_cells(cells, params, x)
+    stats = collect_batch_stats(cells, params, [x])
+    eval_out = make_predict(cells)(params, stats, x)
+    np.testing.assert_allclose(
+        np.asarray(eval_out), np.asarray(train_out), atol=1e-5
+    )
+
+
+def test_eval_step_and_evaluate_aggregate():
+    cells = _tiny_resnet()
+    xs = _batches(2, (4, 32, 32, 3))
+    ys = [jnp.asarray([0, 1, 2, 3], jnp.int32), jnp.asarray([4, 5, 6, 7], jnp.int32)]
+    params = init_cells(cells, jax.random.PRNGKey(2), jnp.zeros_like(xs[0]))
+    stats = collect_batch_stats(cells, params, xs)
+
+    step = make_eval_step(cells)
+    m = step(params, stats, xs[0], ys[0])
+    assert np.isfinite(float(m["loss"]))
+    assert 0 <= int(m["correct"]) <= 4
+
+    agg = evaluate(cells, params, stats, list(zip(xs, ys)))
+    assert agg["count"] == 8
+    assert 0.0 <= agg["accuracy"] <= 1.0
+    assert np.isfinite(agg["loss"])
+
+    # Frozen stats ⇒ deterministic and batch-composition independent:
+    # evaluating one example alone matches its logits inside the batch.
+    pred = make_predict(cells)
+    full = pred(params, stats, xs[0])
+    one = pred(params, stats, xs[0][:1])
+    np.testing.assert_allclose(
+        np.asarray(one[0]), np.asarray(full[0]), atol=1e-5
+    )
+
+
+def test_running_mode_needs_no_stats_for_bn_free_cells():
+    # Cells without BN get an empty stats entry; the plumbing must not
+    # invent a batch_stats collection for them.
+    from mpi4dl_tpu.ops.layers import Dense
+
+    cells = [Dense(features=3)]
+    x = jnp.ones((2, 5), jnp.float32)
+    params = [cells[0].init(jax.random.PRNGKey(0), x)]
+    stats = collect_batch_stats(cells, params, [x])
+    assert stats == [{}]
+    out = make_predict(cells)(params, stats, x)
+    assert out.shape == (2, 3)
