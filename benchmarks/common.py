@@ -18,6 +18,7 @@ mesh, and ``run_training`` feeds each host only its data shard. There is no
 
 from __future__ import annotations
 
+import os
 import statistics
 import sys
 import time
@@ -35,9 +36,14 @@ def build_config(args, spatial: bool, num_cells: int | None = None):
     import jax.numpy as jnp
 
     from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.elastic import maybe_supervise
     from mpi4dl_tpu.parallel import multihost
     from mpi4dl_tpu.utils import enable_compilation_cache
 
+    # --max-restarts: re-exec under the fault-tolerance supervisor. Must
+    # happen HERE — before make_mesh/init touch the accelerator, which a
+    # supervisor process may not hold (TPU access is per-process exclusive).
+    maybe_supervise(args)
     enable_compilation_cache()  # multi-minute XLA compiles amortize across runs
     # Join the multi-host world if one is configured (no-op single-process;
     # the reference's dist.init_process_group moment, comm.py:154-159).
@@ -222,16 +228,48 @@ def run_training(args, trainer, tag: str):
         except FileNotFoundError:
             pass
 
+    from mpi4dl_tpu import elastic
+
+    hb = elastic.heartbeat_path_from_env()  # supervised run (--max-restarts)
+    # Test-only chaos knob: crash/hang the process once it reaches step N
+    # on a fresh (non-resumed) run — exercises the supervisor's two failure
+    # detectors end-to-end (tests/test_elastic.py).
+    crash_at = int(os.environ.get("MPI4DL_TPU_CRASH_AT_STEP", "-1"))
+    hang_at = int(os.environ.get("MPI4DL_TPU_HANG_AT_STEP", "-1"))
+
+    # Resume honors the restored state.step as work ALREADY DONE: earlier
+    # (epoch, step) slots are skipped — consuming their batches, so the
+    # resumed run replays the identical data order — instead of re-running
+    # the full step budget on top of the checkpointed weights (which would
+    # train up to (max_restarts+1)x the requested duration under repeated
+    # crashes).
+    done = int(state.step)
+    seen = 0  # global (epoch, step) slots consumed, trained or skipped
+    trained = 0
     perf = []
     with trace(getattr(args, "trace_dir", None)):
         for epoch in range(args.num_epochs):
             for step, (x, y) in enumerate(ds):
+                max_steps = getattr(args, "max_steps", None)
+                if max_steps is not None and step >= max_steps:
+                    break
+                seen += 1
+                if seen <= done:
+                    continue
+                if not getattr(args, "resume", False):
+                    if int(state.step) == crash_at:
+                        os._exit(3)
+                    if int(state.step) == hang_at:
+                        time.sleep(3600)
                 xs, ys = trainer.shard_batch(jnp.asarray(x), jnp.asarray(y))
                 t0 = time.perf_counter()
                 state, metrics = trainer.train_step(state, xs, ys)
                 loss = float(metrics["loss"])  # blocks
                 dt = time.perf_counter() - t0
-                if step > 0:  # skip compile step, like the reference's warmup
+                if hb:
+                    elastic.touch(hb)
+                trained += 1
+                if trained > 1:  # skip compile step, like the ref's warmup
                     perf.append(global_batch / dt)
                 if args.verbose:
                     print(
@@ -241,9 +279,8 @@ def run_training(args, trainer, tag: str):
                     )
                 if ckpt_dir and int(state.step) % args.checkpoint_every == 0:
                     ckpt.save_checkpoint(ckpt_dir, state)
-                max_steps = getattr(args, "max_steps", None)
-                if max_steps is not None and step + 1 >= max_steps:
-                    break
+    if hb:
+        elastic.touch(hb)  # post-loop phases below must not read as a wedge
     if ckpt_dir:
         ckpt.save_checkpoint(ckpt_dir, state)
     if perf:
@@ -265,4 +302,68 @@ def run_training(args, trainer, tag: str):
         except Exception as e:  # never let accounting kill a benchmark
             line += f" (MFU unavailable: {e})"
         print(line)
+    if getattr(args, "eval_batches", 0):
+        # skip: the per-epoch batch count the training loop consumed — the
+        # eval stream starts past the trained prefix instead of presenting
+        # train-set batches as "evaluation".
+        run_eval(args, trainer, state, ds, args.eval_batches, skip=seen)
     return state
+
+
+def run_eval(args, trainer, state, ds, n: int, skip: int = 0):
+    """BN-calibrate on ``n`` batches, evaluate on ``n`` more
+    (mpi4dl_tpu/evaluate.py; the reference never evaluates). Runs on the
+    plain twin — inference has no reason to pay halo exchanges — with the
+    trained params (pipeline/GEMS params unstacked to the flat cell list).
+
+    The first ``skip`` batches of the stream (the ones training consumed)
+    are passed over so calibration/test data is fresh; if the dataset is
+    too short the stream wraps with a warning (eval then overlaps train
+    data — small datasets have nothing else to offer)."""
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu import elastic
+    from mpi4dl_tpu.evaluate import collect_batch_stats, evaluate
+
+    hb = elastic.heartbeat_path_from_env()
+    cells = trainer.plain_cells
+    params = state.params
+    if hasattr(trainer, "unstack_params"):
+        params = trainer.unstack_params(params)
+
+    it = iter(ds)
+
+    def take():
+        nonlocal it
+        try:
+            b = next(it)
+        except StopIteration:
+            print(
+                "eval: dataset exhausted — wrapping (eval batches overlap "
+                "training data)",
+                flush=True,
+            )
+            it = iter(ds)
+            try:
+                b = next(it)
+            except StopIteration:
+                raise ValueError("eval: dataset is empty") from None
+        if hb:
+            elastic.touch(hb)
+        return b
+
+    for _ in range(skip):
+        take()
+    cal = [jnp.asarray(take()[0]) for _ in range(n)]
+    test = [
+        (jnp.asarray(x), jnp.asarray(y)) for x, y in (take() for _ in range(n))
+    ]
+    stats = collect_batch_stats(cells, params, cal)
+    if hb:
+        elastic.touch(hb)
+    res = evaluate(cells, params, stats, test)
+    print(
+        f"eval ({n} cal / {n} test batches, {res['count']} images): "
+        f"loss {res['loss']:.4f} acc {res['accuracy']:.4f}"
+    )
+    return res

@@ -1,0 +1,185 @@
+"""In-run fault tolerance: supervised training with restart-from-checkpoint.
+
+The reference has no failure handling at all — a dead or wedged rank hangs
+the MPI world until the scheduler kills the job (SURVEY.md §5.3: no
+timeout, no health check, no restart anywhere under ``/root/reference``).
+This module is the TPU-native answer, shaped by how JAX actually fails:
+
+- The runtime is **single-controller**: after a device fault, a poisoned
+  XLA runtime, or a wedged collective, the *process* is unrecoverable —
+  there is no rank-level rejoin the way an MPI world might attempt.
+  Recovery therefore means **process restart + resume from the last atomic
+  checkpoint** (``mpi4dl_tpu/checkpoint.py`` publishes via ``os.replace``,
+  so a crash mid-save can never leave a torn checkpoint).
+- Failures come in two shapes: the process **exits nonzero** (Python
+  exception, runtime abort, OOM kill) — detected by ``wait()`` — or it
+  **wedges silently** (deadlocked collective, hung remote compile, stuck
+  host callback) — detected by a **heartbeat file** the training loop
+  touches every step; staleness beyond ``hang_timeout`` gets the child
+  killed and restarted. The reference's failure mode IS the second shape,
+  and it has no detector.
+
+The supervisor must run **before the process touches the accelerator**: a
+parent holding the TPU would lock its own children out of the device
+(TPU access is exclusive per process). ``benchmarks/common.py`` therefore
+re-execs under :func:`supervise` at ``build_config`` time, before any
+``jax.devices()`` call, when ``--max-restarts`` is set.
+
+Scope: single-host supervision. Multi-host jobs need every host's
+supervisor to restart its process for the world to re-form
+(``jax.distributed`` barriers at init) — run one supervisor per host under
+your scheduler; coordinated multi-host elasticity beyond that is an
+orchestrator concern, not a framework one.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+HEARTBEAT_ENV = "MPI4DL_TPU_HEARTBEAT"
+CHILD_ENV = "MPI4DL_TPU_SUPERVISED_CHILD"
+
+
+def touch(path: str) -> None:
+    """Update the heartbeat file's mtime (creating it if needed). Called by
+    the training loop once per step — cheap (one utime syscall)."""
+    try:
+        os.utime(path, None)
+    except FileNotFoundError:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a"):
+            pass
+
+
+def heartbeat_path_from_env() -> str | None:
+    """The heartbeat file this (child) process should touch, if supervised."""
+    return os.environ.get(HEARTBEAT_ENV)
+
+
+def supervise(
+    argv: list[str],
+    max_restarts: int = 3,
+    hang_timeout: float | None = None,
+    heartbeat_path: str | None = None,
+    resume_arg: str | None = "--resume",
+    poll_interval: float = 0.5,
+    _print=None,
+) -> int:
+    """Run ``python argv`` under supervision; restart on failure.
+
+    argv: script + args (``sys.argv`` of the training entry point).
+    max_restarts: restarts allowed before giving up with the child's rc.
+    hang_timeout: seconds of heartbeat staleness before the child is
+        declared wedged and killed (None/0 disables hang detection). Must
+        comfortably exceed the longest legitimate gap between steps — the
+        first step's XLA compile can take minutes cold.
+    heartbeat_path: file the child touches each step (exported via
+        ``MPI4DL_TPU_HEARTBEAT``). Required for hang detection.
+    resume_arg: appended to restarted children (skipped if already
+        present) so they continue from the newest checkpoint instead of
+        step 0. Pass None when the entry point auto-resumes.
+
+    Returns the final exit code (0 on eventual success).
+    """
+    if hang_timeout and not heartbeat_path:
+        raise ValueError("hang_timeout needs a heartbeat_path")
+    say = _print or (lambda m: print(m, flush=True))
+    restarts = 0
+    while True:
+        cmd = [sys.executable] + list(argv)
+        if restarts and resume_arg and resume_arg not in cmd:
+            cmd.append(resume_arg)
+        env = os.environ.copy()
+        env[CHILD_ENV] = "1"
+        if heartbeat_path:
+            env[HEARTBEAT_ENV] = heartbeat_path
+            touch(heartbeat_path)  # fresh epoch — compile time counts from now
+        proc = subprocess.Popen(cmd, env=env)
+        hung = False
+        # Staleness is timed by OUR monotonic clock from the last observed
+        # mtime CHANGE — never by comparing mtime against time.time(),
+        # which breaks under clock skew between the filesystem and the
+        # system clock (observed ~2s on overlay filesystems).
+        last_mtime: float | None = None
+        last_beat = time.monotonic()  # spawn counts as a beat (compile time)
+        try:
+            while proc.poll() is None:
+                if hang_timeout and heartbeat_path:
+                    try:
+                        mtime = os.path.getmtime(heartbeat_path)
+                    except OSError:
+                        mtime = None
+                    if mtime != last_mtime:
+                        last_mtime = mtime
+                        last_beat = time.monotonic()
+                    stale = time.monotonic() - last_beat
+                    if stale > hang_timeout:
+                        say(
+                            f"elastic: no heartbeat for {stale:.0f}s "
+                            f"(> {hang_timeout}s) — killing wedged child"
+                        )
+                        proc.kill()
+                        proc.wait()
+                        hung = True
+                        break
+                time.sleep(poll_interval)
+        except BaseException:
+            # The supervisor must NEVER orphan a training process — a
+            # KeyboardInterrupt (or any bug here) would otherwise leave a
+            # child holding the accelerator.
+            proc.kill()
+            proc.wait()
+            raise
+        rc = proc.returncode
+        if not hung and rc == 0:
+            if restarts:
+                say(f"elastic: completed after {restarts} restart(s)")
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            say(
+                f"elastic: giving up after {max_restarts} restart(s) "
+                f"(last rc={rc})"
+            )
+            return rc if rc not in (None, 0) else 1
+        say(
+            f"elastic: child {'wedged' if hung else f'failed rc={rc}'} — "
+            f"restarting ({restarts}/{max_restarts})"
+        )
+
+
+def maybe_supervise(args) -> None:
+    """Re-exec the current process under :func:`supervise` if
+    ``--max-restarts`` was requested; no-op in the supervised child (or
+    when unset). MUST be called before the process touches the
+    accelerator — see module docstring. On supervision, never returns
+    (``sys.exit`` with the supervised run's final code)."""
+    if not getattr(args, "max_restarts", 0) or os.environ.get(CHILD_ENV):
+        return
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    if ckpt_dir:
+        hb = os.path.join(ckpt_dir, "heartbeat")
+    else:
+        print(
+            "elastic: --max-restarts without --checkpoint-dir — restarts "
+            "will recompute from step 0",
+            flush=True,
+        )
+        # Per-run unique path: a shared ./heartbeat would let two
+        # concurrent supervised runs keep each other's wedge detector
+        # permanently fresh (neither would ever fire).
+        import tempfile
+
+        fd, hb = tempfile.mkstemp(prefix="mpi4dl_tpu_heartbeat_")
+        os.close(fd)
+    sys.exit(
+        supervise(
+            sys.argv,
+            max_restarts=args.max_restarts,
+            hang_timeout=getattr(args, "hang_timeout", None),
+            heartbeat_path=hb,
+        )
+    )

@@ -150,4 +150,27 @@ def get_parser() -> argparse.ArgumentParser:
         default=None,
         help="Write a jax.profiler trace here (TPU-native addition)",
     )
+    parser.add_argument(
+        "--eval-batches",
+        type=int,
+        default=0,
+        help="After training: calibrate BN on N batches and evaluate on N "
+        "more (TPU-native addition; the reference has no eval path)",
+    )
+    parser.add_argument(
+        "--max-restarts",
+        type=int,
+        default=0,
+        help="Supervise the run: on crash or hang, restart up to N times, "
+        "resuming from the newest checkpoint (TPU-native addition; the "
+        "reference hangs the MPI world on any rank failure)",
+    )
+    parser.add_argument(
+        "--hang-timeout",
+        type=float,
+        default=None,
+        help="With --max-restarts: seconds without a training-step "
+        "heartbeat before the child is declared wedged and restarted "
+        "(must exceed the first step's XLA compile time)",
+    )
     return parser

@@ -99,6 +99,7 @@ class Trainer:
         learning_rate: float = 0.001,
         momentum: float = 0.9,
         remat: bool | str = False,
+        grad_accum: int = 1,
     ):
         """remat: False = store everything; True/"cell" = ``jax.checkpoint``
         per cell; "sqrt" = nested two-level remat (cells grouped into ~√N
@@ -131,6 +132,9 @@ class Trainer:
                 "remat must be False, True, 'cell', 'sqrt', 'scan', "
                 f"'scan_save' or 'cell_save', got {remat!r}"
             )
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = grad_accum
         self.remat = remat
         self.cells = list(cells)
         self.plain_cells = list(plain_cells) if plain_cells is not None else self.cells
@@ -397,16 +401,69 @@ class Trainer:
 
         reset_collective_ids()  # deterministic per-program ids (see there)
 
-        def loss_fn(params):
-            return self._sharded_loss(params, x, y)
+        if self.grad_accum == 1:
+            def loss_fn(params):
+                return self._sharded_loss(params, x, y)
 
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+        else:
+            loss, acc, grads = self._accum_grads(state.params, x, y)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             params=params, opt_state=opt_state, step=state.step + 1
         )
         return new_state, {"loss": loss, "accuracy": acc}
+
+    def _accum_grads(self, params, x, y):
+        """Gradient accumulation: the batch runs as ``grad_accum`` equal
+        chunks under ONE ``lax.scan`` — a bs=B/k working set and a bs=B/k
+        program (one compiled chunk body). The update applies the MEAN of
+        the per-chunk gradients (mean-of-chunk-means == global mean for
+        equal chunks). BatchNorm statistics are per-chunk (a batch-of-B/k
+        forward), so for BN models this is not bit-identical to the
+        unchunked batch — it has exactly the semantics of the reference's
+        GEMS ``--times`` chunks, each of which runs its own BN batch
+        (``gems_master.py:72-103``), and of ``GemsMasterTrainer`` here.
+
+        This is what lands large-image configs whose unchunked program
+        kills the compile pipeline or HBM (e.g. AmoebaNet-D @2048px bs=2 —
+        docs/PERF.md round 3): the per-step batch stays at the reference's
+        published size while the device only ever holds one chunk. The
+        reference's only equivalent is GEMS ``--times`` replication
+        (``gems_master.py:72-103``), which requires the mirrored-model
+        scheme; here it is a plain Trainer knob.
+
+        Chunks are contiguous batch slices: on a DP-sharded batch axis the
+        reshape may insert resharding collectives — grad_accum targets the
+        single-chip / spatial-parallel memory wall, not DP scaling.
+        """
+        k = self.grad_accum
+        b = x.shape[0]
+        if b % k != 0:
+            raise ValueError(f"batch {b} not divisible by grad_accum={k}")
+        xs = x.reshape(k, b // k, *x.shape[1:])
+        ys = y.reshape(k, b // k)
+
+        def chunk_loss(params, xc, yc):
+            return self._sharded_loss(params, xc, yc)
+
+        def body(carry, xy):
+            gsum, lsum, asum = carry
+            (l, a), g = jax.value_and_grad(chunk_loss, has_aux=True)(
+                params, *xy
+            )
+            carry = (jax.tree.map(jnp.add, gsum, g), lsum + l, asum + a)
+            return carry, None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (gsum, lsum, asum), _ = lax.scan(
+            body, (zeros, jnp.zeros(()), jnp.zeros(())), (xs, ys)
+        )
+        grads = jax.tree.map(lambda t: t / k, gsum)
+        return lsum / k, asum / k, grads
 
     def shard_batch(self, x, y):
         """Place a host batch onto the mesh with the trainer's sharding

@@ -339,3 +339,58 @@ def test_packed_spatial_matches_golden():
         )
         x, y = _batch(b=4, size=32, seed=seed + 30)
     _assert_tree_close(state.params, golden_state.params, rtol=5e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_grad_accum_matches_golden(accum):
+    """grad_accum=k applies the MEAN of k per-chunk gradients in one
+    update, each chunk a batch-of-B/k forward (own BN statistics — the
+    reference's GEMS --times chunk semantics, gems_master.py:72-103).
+    Golden: explicit per-chunk value_and_grad + one SGD-momentum update."""
+    import optax
+
+    from mpi4dl_tpu.train import apply_cells, cross_entropy_sum, make_optimizer
+
+    cells = get_resnet_v1(depth=8)
+    cfg = ParallelConfig(batch_size=4, split_size=1, spatial_size=0, image_size=32)
+    trainer = Trainer(
+        cells, num_spatial_cells=0, config=cfg, grad_accum=accum
+    )
+    state = trainer.init(jax.random.PRNGKey(5), (4, 32, 32, 3))
+    params0 = jax.tree.map(jnp.copy, state.params)
+    x, y = _batch(b=4, size=32)
+    xs, ys = trainer.shard_batch(x, y)
+    state, metrics = trainer.train_step(state, xs, ys)
+
+    def chunk_loss(params, xc, yc):
+        logits = apply_cells(cells, params, xc)
+        return cross_entropy_sum(logits, yc) / xc.shape[0]
+
+    b = 4 // accum
+    losses, grads = [], []
+    for i in range(accum):
+        l, g = jax.value_and_grad(chunk_loss)(
+            params0, x[i * b : (i + 1) * b], y[i * b : (i + 1) * b]
+        )
+        losses.append(l)
+        grads.append(g)
+    mean_grads = jax.tree.map(lambda *gs: sum(gs) / accum, *grads)
+    tx = make_optimizer()
+    updates, _ = tx.update(mean_grads, tx.init(params0), params0)
+    want_params = optax.apply_updates(params0, updates)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(sum(losses) / accum), rtol=1e-5
+    )
+    _assert_tree_close(state.params, want_params, rtol=1e-4, atol=1e-6)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    cells = [Dense(10)]
+    cfg = ParallelConfig(batch_size=3, split_size=1, spatial_size=0, image_size=8)
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, grad_accum=2)
+    state = trainer.init(jax.random.PRNGKey(0), (3, 8, 8, 3))
+    x, y = _batch(b=3, size=8)
+    xs, ys = trainer.shard_batch(x, y)
+    with pytest.raises(ValueError, match="not divisible"):
+        trainer.train_step(state, xs, ys)
