@@ -378,10 +378,35 @@ def main():
                 if _RESULT.get("metric"):
                     _emit()
 
+            # Known-fatal sentinel: a failed walk attempt is a ~10-minute
+            # compile the persistent cache can NOT memoize (failures are
+            # never cached) — record it ourselves so every later bench run
+            # skips straight past it. BENCH_RETRY_FATAL=1 retries anyway
+            # (e.g. after a runtime/toolchain change).
+            sentinel = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                ".cache", "bench_known_fatal.json",
+            )
+            try:
+                with open(sentinel) as f:
+                    fatal = json.load(f)
+            except Exception:  # noqa: BLE001 — absent/corrupt = empty
+                fatal = {}
+
             prior = extras.get("resnet110_2048px_bs1", {})
             if prior.get("value") is not None:
                 record(2048, prior["value"])
             for size in (4096, 8192):
+                # Key covers everything that shapes the compiled program —
+                # a different layout/dtype/policy A/B must not be skipped
+                # on another config's verdict.
+                key = (
+                    f"resnet110_{size}px_bs1_{'-'.join(big_remats)}"
+                    f"_{layout}_{jnp.dtype(dtype).name}"
+                )
+                if key in fatal and not os.environ.get("BENCH_RETRY_FATAL"):
+                    record(None, None, f"{size}: known-fatal (cached): {fatal[key][:80]}")
+                    break
                 if _remaining() < 500:
                     record(None, None, f"{size}: budget exhausted before attempt")
                     break
@@ -396,7 +421,15 @@ def main():
                         cells, size, 1, 3, 1, dtype, big_remats
                     )
                 except Exception as e:  # noqa: BLE001 — walk stops here
-                    record(None, None, f"{size}: {type(e).__name__}: {str(e)[:120]}")
+                    msg = f"{type(e).__name__}: {str(e)[:120]}"
+                    record(None, None, f"{size}: {msg}")
+                    fatal[key] = msg
+                    try:
+                        os.makedirs(os.path.dirname(sentinel), exist_ok=True)
+                        with open(sentinel, "w") as f:
+                            json.dump(fatal, f)
+                    except Exception:  # noqa: BLE001 — sentinel is advisory
+                        pass
                     break
                 record(size, round(ips, 3))
             return entry

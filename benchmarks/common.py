@@ -255,6 +255,11 @@ def run_training(args, trainer, tag: str):
                     break
                 seen += 1
                 if seen <= done:
+                    # The fast-forward replay is progress too: with a slow
+                    # data loader a long skip phase must not read as a
+                    # wedge to the supervisor.
+                    if hb:
+                        elastic.touch(hb)
                     continue
                 if not getattr(args, "resume", False):
                     if int(state.step) == crash_at:

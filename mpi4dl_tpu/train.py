@@ -191,20 +191,8 @@ class Trainer:
                 for a, b in zip(lo, lh)
             )
 
-        def at_join(i, h):
-            """Account for the SP→LP tile merge in the shape plan."""
-            if i == self.n_spatial and self.n_spatial > 0:
-
-                def merge(a):
-                    b, hh, ww, c = a.shape
-                    th = self.mesh.shape[AXIS_TILE_H]
-                    tw = self.mesh.shape[AXIS_TILE_W]
-                    return jax.ShapeDtypeStruct((b, hh * th, ww * tw, c), a.dtype)
-
-                return jax.tree.map(merge, h)
-            return h
-
         h = jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        at_join = self._at_join
         plans: list[list[int]] = []
         i, n = 0, len(self.cells)
         while i < n:
@@ -234,6 +222,21 @@ class Trainer:
             i = run[-1] + 1
         return plans
 
+    def _at_join(self, i, h):
+        """Account for the SP→LP tile merge in an abstract shape walk —
+        shared by the scan planner and the save-budget estimator so their
+        post-join footprints cannot drift apart."""
+        if i == self.n_spatial and self.n_spatial > 0:
+
+            def merge(a):
+                b, hh, ww, c = a.shape
+                th = self.mesh.shape[AXIS_TILE_H]
+                tw = self.mesh.shape[AXIS_TILE_W]
+                return jax.ShapeDtypeStruct((b, hh * th, ww * tw, c), a.dtype)
+
+            return jax.tree.map(merge, h)
+        return h
+
     def _apply_cells_scan(self, params, x):
         """The "scan" / "scan_save" remat policies (see ``__init__``): scan
         over repeated cells with compact ``[B, H, W*C]`` carries, barriers
@@ -258,18 +261,58 @@ class Trainer:
         if self.remat in ("scan_save", "cell_save"):
             from mpi4dl_tpu.ops.fastconv import save_conv_outputs
 
+            save_ckpt = functools.partial(
+                jax.checkpoint,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "conv_out"
+                ),
+            )
+            # MPI4DL_TPU_SAVE_BUDGET_MB caps TOTAL estimated conv-output
+            # save bytes; runs beyond the budget fall back to plain
+            # checkpoint (recompute). Full scan_save at >=2048px stores
+            # ~8.5 GB of saves and reproducibly kills this runtime's
+            # remote-compile helper (docs/PERF.md round 3) — a partial
+            # budget keeps the save win where it is cheapest (the
+            # small-activation late stages) while fitting the wall.
+            # Numerics are identical either way (scheduling choice only).
+            budget_mb = float(os.environ.get("MPI4DL_TPU_SAVE_BUDGET_MB", "0"))
+            if budget_mb > 0:
+                ckpts = self._budgeted_ckpts(params, x, budget_mb, save_ckpt)
+            else:
+                ckpts = [save_ckpt] * len(self._scan_plan)
             with save_conv_outputs():
-                return self._apply_scan_plan(
-                    params,
-                    x,
-                    functools.partial(
-                        jax.checkpoint,
-                        policy=jax.checkpoint_policies.save_only_these_names(
-                            "conv_out"
-                        ),
-                    ),
-                )
-        return self._apply_scan_plan(params, x, jax.checkpoint)
+                return self._apply_scan_plan(params, x, ckpts)
+        return self._apply_scan_plan(
+            params, x, [jax.checkpoint] * len(self._scan_plan)
+        )
+
+    def _budgeted_ckpts(self, params, x, budget_mb: float, save_ckpt):
+        """Per-run checkpoint choice under a save-byte budget: estimate
+        each run's conv-output save footprint as ~2x its input activation
+        bytes per cell (bottleneck conv outputs sum to 1.5x the cell I/O
+        channels; 2x is a safe planning bound), then grant saves to the
+        cheapest runs first — maximum recompute avoided per saved byte."""
+        def tree_bytes(t):
+            return sum(
+                int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.tree.leaves(t)
+            )
+
+        shapes = []
+        h = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        for run in self._scan_plan:
+            h = self._at_join(run[0], h)  # SP→LP merge, like the planner
+            shapes.append(2.0 * tree_bytes(h) * len(run))
+            for k in run:
+                h = jax.eval_shape(self.cells[k].apply, params[k], h)
+        order = sorted(range(len(shapes)), key=lambda i: shapes[i])
+        budget = budget_mb * 1e6
+        ckpts = [jax.checkpoint] * len(shapes)
+        for i in order:
+            if shapes[i] <= budget:
+                ckpts[i] = save_ckpt
+                budget -= shapes[i]
+        return ckpts
 
     @staticmethod
     def _compact(tree):
@@ -292,9 +335,9 @@ class Trainer:
             treedef, [a.reshape(s) for a, s in zip(leaves, shapes)]
         )
 
-    def _apply_scan_plan(self, params, x, ckpt):
+    def _apply_scan_plan(self, params, x, ckpts):
         h = x
-        for run in self._scan_plan:
+        for ckpt, run in zip(ckpts, self._scan_plan):
             if len(run) == 1:
                 i = run[0]
                 if i == self.n_spatial and self.n_spatial > 0:

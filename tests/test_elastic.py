@@ -110,7 +110,10 @@ def test_benchmark_crash_resume_end_to_end(tmp_path):
     ckpt = tmp_path / "ckpt"
     env = dict(
         os.environ,
-        PYTHONPATH=REPO,
+        # APPEND to PYTHONPATH: on the TPU runtime the accelerator plugin
+        # itself is delivered via PYTHONPATH (/root/.axon_site) and a
+        # replacement would silently knock the backend out.
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=2",
         MPI4DL_TPU_CRASH_AT_STEP="2",

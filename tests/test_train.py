@@ -394,3 +394,62 @@ def test_grad_accum_rejects_indivisible_batch():
     xs, ys = trainer.shard_batch(x, y)
     with pytest.raises(ValueError, match="not divisible"):
         trainer.train_step(state, xs, ys)
+
+
+def test_save_budget_matches_golden(monkeypatch):
+    """MPI4DL_TPU_SAVE_BUDGET_MB only changes which runs save conv outputs
+    (a scheduling choice) — params/metrics must match the no-remat golden
+    exactly, even with a budget so small nothing gets saved."""
+    monkeypatch.setenv("MPI4DL_TPU_SAVE_BUDGET_MB", "0.001")
+    cells = get_resnet_v1(depth=20)
+    cfg = ParallelConfig(batch_size=4, split_size=1, spatial_size=0, image_size=32)
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat="scan_save")
+    state = trainer.init(jax.random.PRNGKey(3), (4, 32, 32, 3))
+    _, golden_step = single_device_step(cells)
+    gp = jax.tree.map(jnp.copy, state.params)
+    golden_state = TrainState(
+        params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+    )
+    x, y = _batch(b=4, size=32)
+    xs, ys = trainer.shard_batch(x, y)
+    state, metrics = trainer.train_step(state, xs, ys)
+    golden_state, golden_metrics = golden_step(golden_state, x, y)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+    )
+    _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
+
+
+def test_save_budget_spatial_matches_golden(monkeypatch):
+    """The save-budget estimator must account for the SP→LP tile merge
+    (join shapes are 4x the per-tile walk on a 2x2 grid) and still produce
+    golden-exact numerics for a spatial scan_save trainer."""
+    monkeypatch.setenv("MPI4DL_TPU_SAVE_BUDGET_MB", "2")
+    cfg = ParallelConfig(
+        batch_size=4,
+        split_size=1,
+        spatial_size=1,
+        num_spatial_parts=(4,),
+        slice_method="square",
+        image_size=32,
+    )
+    spatial = get_resnet_v1(depth=14, spatial_cells=5, cross_tile_bn=True)
+    plain = get_resnet_v1(depth=14, spatial_cells=0)
+    trainer = Trainer(
+        spatial, num_spatial_cells=5, config=cfg, plain_cells=plain,
+        remat="scan_save",
+    )
+    state = trainer.init(jax.random.PRNGKey(4), (4, 32, 32, 3))
+    _, golden_step = single_device_step(plain)
+    gp = jax.tree.map(jnp.copy, state.params)
+    golden_state = TrainState(
+        params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+    )
+    x, y = _batch(b=4, size=32)
+    xs, ys = trainer.shard_batch(x, y)
+    state, metrics = trainer.train_step(state, xs, ys)
+    golden_state, golden_metrics = golden_step(golden_state, x, y)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+    )
+    _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
