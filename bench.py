@@ -1,17 +1,19 @@
 """Headline benchmark: training throughput vs the reference's published numbers.
 
-Headline metric (the JSON ``value``): ResNet-110(v2) @1024px bs=2, vs the
-reference's best published ResNet@1024 number ~3.1 img/s (batch 2, spatial
-parallelism, square slicing + halo-D2, multi-GPU MVAPICH2-GDR cluster; read
-off ``docs/assets/images/ResNet_img_size_1024.png`` — BASELINE.md).
+Headline metric (the JSON ``value``): AmoebaNet-D (18 layers / 416 filters,
+the reference benchmark defaults — its headline model; BASELINE.json configs
+are AmoebaNet-centric) @1024px bs=2, vs the reference's best published
+AmoebaNet@1024 number ~3.0 img/s (multi-GPU MVAPICH2-GDR cluster; read off
+``docs/assets/images/AmeobaNet_img_size_1024.png`` — BASELINE.md).
+``BENCH_MODEL=resnet`` switches the headline to ResNet-110(v2) @1024 bs2
+(ref best ~3.1, ``ResNet_img_size_1024.png``).
 
-``extras`` carries ResNet@2048 and the AmoebaNet-D (18 layers / 416 filters,
-the reference benchmark defaults) numbers against ITS published charts —
-the reference's headline model (BASELINE.json configs are AmoebaNet-centric):
+``extras`` carries the other published chart points:
 
+- ResNet 1024px bs=2: ref best ≈3.1 img/s (ResNet_img_size_1024.png)
 - ResNet 2048px bs=1: ref best ≈1.0 img/s (ResNet_img_size_2048.png)
-- AmoebaNet 1024px bs=2: ref best ≈3.0 img/s (AmeobaNet_img_size_1024.png)
 - AmoebaNet 2048px bs=2: ref best ≈5.1 img/s (AmeobaNet_img_size_2048.png)
+- AmoebaNet 2048px bs=1: ref best ≈2.9 img/s (same chart)
 
 Every entry also reports MFU (model-FLOPs utilization, analytic conv+dot
 count — see mpi4dl_tpu/flops.py); the north star is ≥45% (BASELINE.json).
@@ -36,6 +38,7 @@ masquerade as a measurement).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import signal
@@ -250,25 +253,74 @@ def main():
             "vs_baseline": round(ips / baseline, 3),
         }
 
+    layers, filters = (18, 416) if not on_cpu else (6, 64)
+
+    def measure_amoeba(size, b):
+        """One AmoebaNet-D point (the reference's headline model,
+        benchmark-default 18 layers / 416 filters). >=2048px with bs>1
+        runs as bs-1 scanned chunks (gradient accumulation, GEMS --times
+        chunk semantics): the unchunked program reproducibly kills the
+        remote-compile helper at EVERY remat policy while bs=1 compiles
+        and runs (docs/PERF.md round 3). BENCH_NO_ACCUM=1 reverts."""
+        cells = amoebanetd(
+            num_classes=10, num_layers=layers, num_filters=filters,
+            dtype=dtype,
+        )
+        accum = (
+            b if size >= 2048 and b > 1
+            and not os.environ.get("BENCH_NO_ACCUM") else 1
+        )
+        ips, remat = _train_throughput(
+            cells, size, b, steps, warmup, dtype,
+            remats_for(size, amoeba_remats), grad_accum=accum,
+        )
+        util = mfu(
+            ips, train_flops_per_image(cells, size, dtype),
+            n_devices=jax.device_count(),
+        )
+        entry = {
+            "value": round(ips, 3),
+            "remat": remat,
+            "mfu": round(util, 4) if util is not None else None,
+        }
+        if accum > 1:
+            entry["grad_accum"] = accum
+        base = AMOEBA_BASELINE.get((size, b))
+        if base:
+            entry["vs_baseline"] = round(ips / base, 3)
+        return entry
+
     headline_error = None
 
-    # --- Headline: ResNet-110 @1024 bs2 ------------------------------------
-    if which in ("resnet", "all"):
-        try:
+    # --- Headline ----------------------------------------------------------
+    # AmoebaNet-D @1024 bs2 — the reference's headline model (BASELINE.json
+    # configs are AmoebaNet-centric; ref best ~3.0 img/s). BENCH_MODEL=
+    # resnet keeps the previous ResNet-110 headline instead.
+    try:
+        if which in ("amoebanet", "all"):
+            h_size, h_b = (image_size, batch) if not on_cpu else (64, 2)
+            entry = dict(measure_amoeba(h_size, h_b))
+            entry.setdefault("vs_baseline", None)
+            _RESULT.update(
+                metric=f"amoebanetd_{h_size}px_bs{h_b}_train_{platform}",
+                unit="images/sec",
+                **entry,
+            )
+        else:
             entry = measure_resnet(image_size, batch, RESNET_BASELINE)
             _RESULT.update(
                 metric=f"resnet110_{image_size}px_bs{batch}_train_{platform}",
                 unit="images/sec",
                 **entry,
             )
-            _emit()  # the driver has its number from this moment on
-        except Exception as e:  # noqa: BLE001 — extras may still succeed
-            headline_error = f"{type(e).__name__}: {str(e)[:200]}"
-            # Record in the result dict, not just a comment line: if an
-            # extra later gets promoted, the JSON must still show that the
-            # ResNet headline itself regressed.
-            _RESULT["headline_error"] = headline_error
-            print(f"# headline failed: {headline_error}", flush=True)
+        _emit()  # the driver has its number from this moment on
+    except Exception as e:  # noqa: BLE001 — extras may still succeed
+        headline_error = f"{type(e).__name__}: {str(e)[:200]}"
+        # Record in the result dict, not just a comment line: if an
+        # extra later gets promoted, the JSON must still show that the
+        # headline itself regressed.
+        _RESULT["headline_error"] = headline_error
+        print(f"# headline failed: {headline_error}", flush=True)
 
     def run_extra(tag, fn, est_seconds=300.0):
         """Run one extra under the budget; record + re-emit either way.
@@ -297,6 +349,13 @@ def main():
 
     # --- Extras, cheapest-win first, each one re-emitting ------------------
     if which in ("resnet", "all") and not on_cpu:
+        if which == "all":
+            # The other model family's @1024 point (ref ResNet best ~3.1).
+            run_extra(
+                f"resnet110_{image_size}px_bs{batch}",
+                lambda: measure_resnet(image_size, batch, RESNET_BASELINE),
+                est_seconds=400.0,
+            )
         # High-res point (BASELINE.md: ref ResNet@2048 SP best ~1.0 img/s
         # bs=1; bs=2 OOMs every published scheme).
         run_extra(
@@ -304,52 +363,19 @@ def main():
             lambda: measure_resnet(2048, 1, RESNET_2048_BASELINE),
             est_seconds=400.0,
         )
-
-    if which in ("amoebanet", "all"):
-        amoeba_cfgs = (
-            [(1024, 2), (2048, 2), (2048, 1)] if not on_cpu else [(64, 2)]
+    elif which == "all" and on_cpu:
+        run_extra(
+            f"resnet110_{image_size}px_bs{batch}",
+            lambda: measure_resnet(image_size, batch, RESNET_BASELINE),
+            est_seconds=120.0,
         )
-        layers, filters = (18, 416) if not on_cpu else (6, 64)
-        for size, b in amoeba_cfgs:
-            def amoeba(size=size, b=b):
-                cells = amoebanetd(
-                    num_classes=10, num_layers=layers, num_filters=filters,
-                    dtype=dtype,
-                )
-                # >=2048px with bs>1: the unchunked program reproducibly
-                # kills the remote-compile helper at EVERY remat policy
-                # (docs/PERF.md round 3) while bs=1 compiles and runs —
-                # run the published batch size as bs-1 scanned chunks
-                # (gradient accumulation, GEMS --times chunk semantics).
-                # BENCH_NO_ACCUM=1 reverts for A/B.
-                accum = (
-                    b if size >= 2048 and b > 1
-                    and not os.environ.get("BENCH_NO_ACCUM") else 1
-                )
-                ips, remat = _train_throughput(
-                    cells, size, b, steps, warmup, dtype,
-                    remats_for(size, amoeba_remats), grad_accum=accum,
-                )
-                util = mfu(
-                    ips, train_flops_per_image(cells, size, dtype),
-                    n_devices=jax.device_count(),
-                )
-                entry = {
-                    "value": round(ips, 3),
-                    "remat": remat,
-                    "mfu": round(util, 4) if util is not None else None,
-                }
-                if accum > 1:
-                    entry["grad_accum"] = accum
-                base = AMOEBA_BASELINE.get((size, b))
-                if base:
-                    entry["vs_baseline"] = round(ips / base, 3)
-                return entry
 
+    if which in ("amoebanet", "all") and not on_cpu:
+        for size, b in [(2048, 2), (2048, 1)]:
             run_extra(
                 f"amoebanetd_{size}px_bs{b}",
-                amoeba,
-                est_seconds=30.0 if on_cpu else (600.0 if size >= 2048 else 400.0),
+                functools.partial(measure_amoeba, size, b),
+                est_seconds=600.0,
             )
 
     if which in ("resnet", "all") and not on_cpu:
@@ -400,9 +426,11 @@ def main():
                 # Key covers everything that shapes the compiled program —
                 # a different layout/dtype/policy A/B must not be skipped
                 # on another config's verdict.
+                from mpi4dl_tpu.train import scan_unroll
+
                 key = (
                     f"resnet110_{size}px_bs1_{'-'.join(big_remats)}"
-                    f"_{layout}_{jnp.dtype(dtype).name}"
+                    f"_{layout}_{jnp.dtype(dtype).name}_u{scan_unroll()}"
                 )
                 if key in fatal and not os.environ.get("BENCH_RETRY_FATAL"):
                     record(None, None, f"{size}: known-fatal (cached): {fatal[key][:80]}")

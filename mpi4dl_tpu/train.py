@@ -47,6 +47,15 @@ from mpi4dl_tpu.config import (
 from mpi4dl_tpu.parallel.halo import gather_tiles
 
 
+def scan_unroll() -> int:
+    """Resolved lax.scan unroll factor for scanned cell runs (default 3,
+    ``MPI4DL_TPU_SCAN_UNROLL`` overrides — measurements in the
+    ``_apply_scan_plan`` comment / docs/PERF.md). The single source of
+    truth: anything keying compiled-program identity (bench known-fatal
+    cache) must use THIS, not its own copy of the default."""
+    return int(os.environ.get("MPI4DL_TPU_SCAN_UNROLL", "3"))
+
+
 def make_optimizer(learning_rate: float = 0.001, momentum: float = 0.9):
     """Reference default optimizer (``mp_pipeline.py:230-234``)."""
     return optax.sgd(learning_rate, momentum=momentum)
@@ -363,11 +372,15 @@ class Trainer:
                 return ckpt(apply_compact)(p, hc), None
 
             # Unrolling amortizes the scan machinery (parameter
-            # dynamic-slices, carry copies — measured ~12% of the AmoebaNet
-            # step, docs/PERF.md round 3) at the cost of a proportionally
-            # bigger program; 1 = off (the safe default for the compile-
-            # helper-limited runtime).
-            unroll = int(os.environ.get("MPI4DL_TPU_SCAN_UNROLL", "1"))
+            # dynamic-slices, carry copies, loop overhead) at the cost of a
+            # proportionally bigger program. Measured on one v5e (docs/
+            # PERF.md round 3): unroll=3 takes AmoebaNet-D @1024 bs2 from
+            # 4.92 to 6.37 img/s (+29%) and @2048 bs1 from 1.09 to 1.27
+            # (+16%); ResNet is neutral (its hot path is cell_save, and its
+            # @2048 scan is recompute-bound, 0.495 -> 0.492). unroll=6
+            # matches unroll=3, so 3 is the default — the smallest program
+            # that captures the win. MPI4DL_TPU_SCAN_UNROLL overrides.
+            unroll = scan_unroll()
             hc, _ = lax.scan(body, hc, stacked, unroll=unroll)
             h = self._restore(hc, shapes)
         return h
