@@ -372,6 +372,8 @@ def main():
 
     if which in ("amoebanet", "all") and not on_cpu:
         for size, b in [(2048, 2), (2048, 1)]:
+            if (size, b) == (h_size, h_b):
+                continue  # already the headline (e.g. BENCH_IMAGE_SIZE=2048)
             run_extra(
                 f"amoebanetd_{size}px_bs{b}",
                 functools.partial(measure_amoeba, size, b),

@@ -47,6 +47,16 @@ from mpi4dl_tpu.config import (
 from mpi4dl_tpu.parallel.halo import gather_tiles
 
 
+def _conv_save_ckpt():
+    """jax.checkpoint saving the ``conv_out``-tagged conv outputs — the one
+    constructor for every conv-saving remat policy (scan_save / cell_save /
+    group_save), so the tag name and policy cannot drift between them."""
+    return functools.partial(
+        jax.checkpoint,
+        policy=jax.checkpoint_policies.save_only_these_names("conv_out"),
+    )
+
+
 def scan_unroll() -> int:
     """Resolved lax.scan unroll factor for scanned cell runs (default 3,
     ``MPI4DL_TPU_SCAN_UNROLL`` overrides — measurements in the
@@ -135,11 +145,12 @@ class Trainer:
         if num_spatial_cells > 0 and plain_cells is None:
             raise ValueError("spatial models need plain_cells for initialization")
         if remat not in (
-            False, True, "cell", "sqrt", "scan", "scan_save", "cell_save"
+            False, True, "cell", "sqrt", "scan", "scan_save", "cell_save",
+            "group_save",
         ):
             raise ValueError(
                 "remat must be False, True, 'cell', 'sqrt', 'scan', "
-                f"'scan_save' or 'cell_save', got {remat!r}"
+                f"'scan_save', 'cell_save' or 'group_save', got {remat!r}"
             )
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -270,12 +281,7 @@ class Trainer:
         if self.remat in ("scan_save", "cell_save"):
             from mpi4dl_tpu.ops.fastconv import save_conv_outputs
 
-            save_ckpt = functools.partial(
-                jax.checkpoint,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "conv_out"
-                ),
-            )
+            save_ckpt = _conv_save_ckpt()
             # MPI4DL_TPU_SAVE_BUDGET_MB caps TOTAL estimated conv-output
             # save bytes; runs beyond the budget fall back to plain
             # checkpoint (recompute). Full scan_save at >=2048px stores
@@ -414,6 +420,32 @@ class Trainer:
                     return h
 
                 h = jax.checkpoint(run_group)([params[i] for i in idx], h)
+            return h
+        if self.remat == "group_save":
+            # The scan-unroll lesson (docs/PERF.md round 3: +29% AmoebaNet)
+            # applied to the no-scan path: checkpoint GROUPS of consecutive
+            # cells (MPI4DL_TPU_GROUP_SIZE, default 3) with conv-output
+            # saves, so XLA schedules/fuses across the cell boundaries that
+            # per-cell checkpoints (cell_save) wall off, while the group
+            # barrier still bounds how many rematerialized backwards are in
+            # flight.
+            from mpi4dl_tpu.ops.fastconv import save_conv_outputs
+
+            g = max(int(os.environ.get("MPI4DL_TPU_GROUP_SIZE", "3")), 1)
+            save_ckpt = _conv_save_ckpt()
+            n = len(self.cells)
+            h = x
+            with save_conv_outputs():
+                for start in range(0, n, g):
+                    idx = list(range(start, min(start + g, n)))
+
+                    def run_group(group_params, h, idx=idx):
+                        for i, p in zip(idx, group_params):
+                            h = run_cell(i, p, h)
+                        return h
+
+                    h = save_ckpt(run_group)([params[i] for i in idx], h)
+                    h = lax.optimization_barrier(h)
             return h
         h = x
         for i in range(len(self.cells)):

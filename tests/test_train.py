@@ -131,7 +131,7 @@ def test_pure_dp_no_spatial():
     _assert_tree_close(state.params, golden_state.params, rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("remat", ["cell", "sqrt", "scan", "scan_save"])
+@pytest.mark.parametrize("remat", ["cell", "sqrt", "scan", "scan_save", "group_save"])
 def test_remat_policies_match_golden(remat):
     """Every remat policy is a pure scheduling choice: losses, metrics, and
     updated parameters must be identical to the no-remat golden step. "scan"
