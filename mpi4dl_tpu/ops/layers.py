@@ -350,6 +350,12 @@ class Pool(nn.Module):
             if (sh, sw) == (1, 1):
                 # Stride-1: shifted-maximum decomposition (cheap backward;
                 # see max_pool_s1_valid). -inf edge pad == torch MaxPool2d.
+                # Strided pools deliberately stay on reduce_window: slicing
+                # the s1 maxima by the stride is forward-identical but
+                # measured a 22% END-TO-END REGRESSION on AmoebaNet@1024
+                # (6.37 -> 4.94 img/s) — the full-resolution maximum tree +
+                # its full-res backward select chain costs far more than the
+                # select_and_scatter it removes (docs/PERF.md round 3).
                 if pad != ((0, 0), (0, 0)):
                     x = lax.pad(
                         x,
