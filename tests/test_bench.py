@@ -1,0 +1,88 @@
+"""bench.py contract tests: the driver parses its stdout, so the output
+protocol (one complete JSON line per milestone, headline first, explicit
+error shape, nonzero exit on no-measurement) is product surface. Runs the
+real script as a subprocess on CPU with tiny shapes."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(tmp_path, extra_env, timeout=900):
+    # Strip inherited BENCH_* knobs: a developer's exported BENCH_IMAGE_SIZE
+    # would disable bench.py's CPU shrink path and train at full resolution
+    # on CPU (a guaranteed timeout), or silently change what's under test.
+    base = {k: v for k, v in os.environ.items() if not k.startswith("BENCH_")}
+    env = dict(
+        base,
+        PYTHONPATH=REPO + os.pathsep + base.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        MPI4DL_TPU_CONV_IMPL="xla",
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jaxcache"),
+        **extra_env,
+    )
+    return subprocess.run(
+        [sys.executable, BENCH],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _json_lines(out):
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    return [json.loads(l) for l in lines]
+
+
+def test_amoebanet_headline_line_shape(tmp_path):
+    out = _run(tmp_path, {"BENCH_MODEL": "amoebanet"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    records = _json_lines(out)
+    assert records, "no JSON line emitted"
+    # Every line is a complete record; the driver may keep first OR last.
+    for r in records:
+        assert r["unit"] == "images/sec"
+        assert r["metric"].startswith("amoebanetd_")
+        assert isinstance(r["value"], (int, float)) and r["value"] > 0
+        assert "vs_baseline" in r
+
+
+def test_resnet_headline(tmp_path):
+    out = _run(tmp_path, {"BENCH_MODEL": "resnet"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    records = _json_lines(out)
+    assert records[0]["metric"].startswith("resnet110_")
+    assert records[0]["value"] > 0
+    assert records[0]["vs_baseline"] is not None
+
+
+def test_budget_exhaustion_skips_extras_but_keeps_headline(tmp_path):
+    # BENCH_MODEL=all on CPU: amoebanet headline + one resnet extra. A
+    # 1-second budget cannot erase the headline (the budget gates extras
+    # only), and the skipped extra must say so explicitly.
+    out = _run(tmp_path, {"BENCH_MODEL": "all", "BENCH_TIME_BUDGET": "1"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    final = _json_lines(out)[-1]
+    assert final["metric"].startswith("amoebanetd_")
+    assert final["value"] > 0
+    (extra,) = final["extras"].values()
+    assert "insufficient budget" in extra["skipped"]
+
+
+def test_bad_budget_fails_before_compile(tmp_path):
+    out = _run(tmp_path, {"BENCH_TIME_BUDGET": "not-a-number"}, timeout=120)
+    assert out.returncode != 0
+    # The failure must still leave one parseable line on stdout.
+    records = _json_lines(out)
+    assert records and records[-1].get("error")
+
+
+def test_bad_model_rejected(tmp_path):
+    out = _run(tmp_path, {"BENCH_MODEL": "vgg"}, timeout=120)
+    assert out.returncode != 0
+    records = _json_lines(out)
+    assert records and "BENCH_MODEL" in records[-1]["error"]
