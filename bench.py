@@ -349,19 +349,26 @@ def main():
 
     # --- Extras, cheapest-win first, each one re-emitting ------------------
     if which in ("resnet", "all") and not on_cpu:
+        # est_seconds below are WARM-cache figures (the persistent
+        # compilation cache makes reruns 3-5x cheaper than first-ever
+        # compiles). Underestimating a cold run is the safe direction:
+        # the budget only gates STARTING an extra, every completed
+        # milestone is already emitted, and a driver-side kill therefore
+        # loses nothing — whereas overestimating silently skips extras a
+        # warm run had plenty of time for.
         if which == "all":
             # The other model family's @1024 point (ref ResNet best ~3.1).
             run_extra(
                 f"resnet110_{image_size}px_bs{batch}",
                 lambda: measure_resnet(image_size, batch, RESNET_BASELINE),
-                est_seconds=400.0,
+                est_seconds=300.0,
             )
         # High-res point (BASELINE.md: ref ResNet@2048 SP best ~1.0 img/s
         # bs=1; bs=2 OOMs every published scheme).
         run_extra(
             "resnet110_2048px_bs1",
             lambda: measure_resnet(2048, 1, RESNET_2048_BASELINE),
-            est_seconds=400.0,
+            est_seconds=200.0,
         )
     elif which == "all" and on_cpu:
         run_extra(
@@ -377,7 +384,7 @@ def main():
             run_extra(
                 f"amoebanetd_{size}px_bs{b}",
                 functools.partial(measure_amoeba, size, b),
-                est_seconds=600.0,
+                est_seconds=300.0,
             )
 
     if which in ("resnet", "all") and not on_cpu:
@@ -437,13 +444,34 @@ def main():
                 if key in fatal and not os.environ.get("BENCH_RETRY_FATAL"):
                     record(None, None, f"{size}: known-fatal (cached): {fatal[key][:80]}")
                     break
-                if _remaining() < 500:
+                if _remaining() < 150:
                     record(None, None, f"{size}: budget exhausted before attempt")
                     break
                 cells = get_resnet_v2(
                     depth=get_depth(2, 12), num_classes=10,
                     pool_kernel=size // 4, layout=layout, dtype=dtype,
                 )
+
+                def write_sentinel():
+                    try:
+                        os.makedirs(os.path.dirname(sentinel), exist_ok=True)
+                        with open(sentinel, "w") as f:
+                            json.dump(fatal, f)
+                    except Exception:  # noqa: BLE001 — sentinel is advisory
+                        pass
+
+                # Pre-mark the attempt: a failed walk compile takes ~10
+                # uncacheable minutes, and a driver kill mid-compile would
+                # otherwise erase the evidence — every later run would
+                # re-enter the same doomed compile. Success REMOVES the
+                # marker, so a kill of a would-have-succeeded attempt costs
+                # one skipped retry (BENCH_RETRY_FATAL=1 overrides), not a
+                # permanently wrong verdict.
+                fatal[key] = (
+                    "attempt started but never concluded — likely killed "
+                    "mid-compile by the driver's budget"
+                )
+                write_sentinel()
                 try:
                     # big_remats: the only policies that fit >=2048px
                     # (PERF.md r3); honors a BENCH_REMAT override.
@@ -454,17 +482,14 @@ def main():
                     msg = f"{type(e).__name__}: {str(e)[:120]}"
                     record(None, None, f"{size}: {msg}")
                     fatal[key] = msg
-                    try:
-                        os.makedirs(os.path.dirname(sentinel), exist_ok=True)
-                        with open(sentinel, "w") as f:
-                            json.dump(fatal, f)
-                    except Exception:  # noqa: BLE001 — sentinel is advisory
-                        pass
+                    write_sentinel()
                     break
+                fatal.pop(key, None)
+                write_sentinel()
                 record(size, round(ips, 3))
             return entry
 
-        run_extra("resnet_peak_pixels", peak_px, est_seconds=500.0)
+        run_extra("resnet_peak_pixels", peak_px, est_seconds=150.0)
 
     if _RESULT.get("value") is None:
         # ADVICE r2: an all-failure run must say so explicitly, not hand

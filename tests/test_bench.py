@@ -14,7 +14,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def _run(tmp_path, extra_env, timeout=900):
+@pytest.fixture(scope="session")
+def cache_dir(tmp_path_factory):
+    """One compilation cache for all bench subprocesses: the three
+    measurement tests compile overlapping programs (the amoebanet 64px
+    headline twice), and the cache is keyed by program, so sharing it
+    saves minutes with no isolation cost."""
+    return str(tmp_path_factory.mktemp("jaxcache"))
+
+
+def _run(cache_dir, extra_env, timeout=900):
     # Strip inherited BENCH_* knobs: a developer's exported BENCH_IMAGE_SIZE
     # would disable bench.py's CPU shrink path and train at full resolution
     # on CPU (a guaranteed timeout), or silently change what's under test.
@@ -24,7 +33,7 @@ def _run(tmp_path, extra_env, timeout=900):
         PYTHONPATH=REPO + os.pathsep + base.get("PYTHONPATH", ""),
         JAX_PLATFORMS="cpu",
         MPI4DL_TPU_CONV_IMPL="xla",
-        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jaxcache"),
+        JAX_COMPILATION_CACHE_DIR=cache_dir,
         **extra_env,
     )
     return subprocess.run(
@@ -38,8 +47,8 @@ def _json_lines(out):
     return [json.loads(l) for l in lines]
 
 
-def test_amoebanet_headline_line_shape(tmp_path):
-    out = _run(tmp_path, {"BENCH_MODEL": "amoebanet"})
+def test_amoebanet_headline_line_shape(cache_dir):
+    out = _run(cache_dir, {"BENCH_MODEL": "amoebanet"})
     assert out.returncode == 0, out.stderr[-2000:]
     records = _json_lines(out)
     assert records, "no JSON line emitted"
@@ -51,8 +60,8 @@ def test_amoebanet_headline_line_shape(tmp_path):
         assert "vs_baseline" in r
 
 
-def test_resnet_headline(tmp_path):
-    out = _run(tmp_path, {"BENCH_MODEL": "resnet"})
+def test_resnet_headline(cache_dir):
+    out = _run(cache_dir, {"BENCH_MODEL": "resnet"})
     assert out.returncode == 0, out.stderr[-2000:]
     records = _json_lines(out)
     assert records[0]["metric"].startswith("resnet110_")
@@ -60,11 +69,11 @@ def test_resnet_headline(tmp_path):
     assert records[0]["vs_baseline"] is not None
 
 
-def test_budget_exhaustion_skips_extras_but_keeps_headline(tmp_path):
+def test_budget_exhaustion_skips_extras_but_keeps_headline(cache_dir):
     # BENCH_MODEL=all on CPU: amoebanet headline + one resnet extra. A
     # 1-second budget cannot erase the headline (the budget gates extras
     # only), and the skipped extra must say so explicitly.
-    out = _run(tmp_path, {"BENCH_MODEL": "all", "BENCH_TIME_BUDGET": "1"})
+    out = _run(cache_dir, {"BENCH_MODEL": "all", "BENCH_TIME_BUDGET": "1"})
     assert out.returncode == 0, out.stderr[-2000:]
     final = _json_lines(out)[-1]
     assert final["metric"].startswith("amoebanetd_")
@@ -73,16 +82,16 @@ def test_budget_exhaustion_skips_extras_but_keeps_headline(tmp_path):
     assert "insufficient budget" in extra["skipped"]
 
 
-def test_bad_budget_fails_before_compile(tmp_path):
-    out = _run(tmp_path, {"BENCH_TIME_BUDGET": "not-a-number"}, timeout=120)
+def test_bad_budget_fails_before_compile(cache_dir):
+    out = _run(cache_dir, {"BENCH_TIME_BUDGET": "not-a-number"}, timeout=120)
     assert out.returncode != 0
     # The failure must still leave one parseable line on stdout.
     records = _json_lines(out)
     assert records and records[-1].get("error")
 
 
-def test_bad_model_rejected(tmp_path):
-    out = _run(tmp_path, {"BENCH_MODEL": "vgg"}, timeout=120)
+def test_bad_model_rejected(cache_dir):
+    out = _run(cache_dir, {"BENCH_MODEL": "vgg"}, timeout=120)
     assert out.returncode != 0
     records = _json_lines(out)
     assert records and "BENCH_MODEL" in records[-1]["error"]
