@@ -77,7 +77,11 @@ def build_resnet(args, cfg, spatial_cells=0):
     from mpi4dl_tpu.utils import get_depth
 
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
-    depth = get_depth(2, 12)  # the reference resnet benchmarks' ResNet-110
+    # The reference resnet benchmarks hardcode resnet_n=12 (ResNet-110,
+    # e.g. benchmark_resnet_lp.py:92-94); MPI4DL_TPU_RESNET_N overrides the
+    # same constant here so smoke tests/CI can drive the full script
+    # plumbing without paying a 54-cell compile.
+    depth = get_depth(2, int(os.environ.get("MPI4DL_TPU_RESNET_N", "12")))
     kw = dict(
         depth=depth,
         num_classes=args.num_classes,
@@ -308,18 +312,34 @@ def run_training(args, trainer, tag: str):
             line += f" (MFU unavailable: {e})"
         print(line)
     if getattr(args, "eval_batches", 0):
-        # skip: the per-epoch batch count the training loop consumed — the
-        # eval stream starts past the trained prefix instead of presenting
+        # skip: the (epoch, step) slots training consumed, reduced modulo
+        # the dataset's per-epoch length — `seen` accumulates across epochs
+        # and resume fast-forwards, and skipping whole dataset revolutions
+        # would just wrap the stream back to the same position after
+        # pointless "dataset exhausted" warnings (ADVICE r3). The eval
+        # stream starts past the trained prefix instead of presenting
         # train-set batches as "evaluation".
-        run_eval(args, trainer, state, ds, args.eval_batches, skip=seen)
+        try:
+            per_epoch = len(ds)
+        except TypeError:
+            per_epoch = 0
+        run_eval(
+            args, trainer, state, ds, args.eval_batches,
+            skip=seen % per_epoch if per_epoch else seen,
+        )
     return state
 
 
 def run_eval(args, trainer, state, ds, n: int, skip: int = 0):
     """BN-calibrate on ``n`` batches, evaluate on ``n`` more
-    (mpi4dl_tpu/evaluate.py; the reference never evaluates). Runs on the
-    plain twin — inference has no reason to pay halo exchanges — with the
-    trained params (pipeline/GEMS params unstacked to the flat cell list).
+    (mpi4dl_tpu/evaluate.py; the reference never evaluates).
+
+    Spatial ``Trainer`` configs evaluate through the trainer's own sharded
+    forward (``spatial_collect_batch_stats``/``spatial_evaluate``) — at the
+    resolutions this framework targets the full-image plain twin cannot run
+    on one device. Pipeline/GEMS configs evaluate on the plain twin with
+    the trained params unstacked to the flat cell list (their stage-sharded
+    forward exists for training; eval at their scale re-hosts the params).
 
     The first ``skip`` batches of the stream (the ones training consumed)
     are passed over so calibration/test data is fresh; if the dataset is
@@ -335,6 +355,10 @@ def run_eval(args, trainer, state, ds, n: int, skip: int = 0):
     params = state.params
     if hasattr(trainer, "unstack_params"):
         params = trainer.unstack_params(params)
+    spatial = (
+        not hasattr(trainer, "unstack_params")
+        and getattr(trainer, "n_spatial", 0) > 0
+    )
 
     it = iter(ds)
 
@@ -363,10 +387,21 @@ def run_eval(args, trainer, state, ds, n: int, skip: int = 0):
     test = [
         (jnp.asarray(x), jnp.asarray(y)) for x, y in (take() for _ in range(n))
     ]
-    stats = collect_batch_stats(cells, params, cal)
-    if hb:
-        elastic.touch(hb)
-    res = evaluate(cells, params, stats, test)
+    if spatial:
+        from mpi4dl_tpu.evaluate import (
+            spatial_collect_batch_stats,
+            spatial_evaluate,
+        )
+
+        stats = spatial_collect_batch_stats(trainer, params, cal)
+        if hb:
+            elastic.touch(hb)
+        res = spatial_evaluate(trainer, params, stats, test)
+    else:
+        stats = collect_batch_stats(cells, params, cal)
+        if hb:
+            elastic.touch(hb)
+        res = evaluate(cells, params, stats, test)
     print(
         f"eval ({n} cal / {n} test batches, {res['count']} images): "
         f"loss {res['loss']:.4f} acc {res['accuracy']:.4f}"
