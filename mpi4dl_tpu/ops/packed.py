@@ -51,7 +51,6 @@ Parameter trees match the stock modules exactly (kernel [kh,kw,C,O], bias
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
 import flax.linen as nn
@@ -291,12 +290,14 @@ class PackedTrainBatchNorm(nn.Module):
             w = (lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
             b = (bias - mean * lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
             return x * jnp.tile(w, self.pack) + jnp.tile(b, self.pack)
-        red = tuple(range(x.ndim - 1))
-        n = math.prod(x.shape[a] for a in red) * self.pack
-        ssum = jnp.sum(x, red, dtype=jnp.float32).reshape(self.pack, c)
-        sqsum = jnp.sum(jnp.square(x.astype(jnp.float32)), red).reshape(self.pack, c)
-        mean = jnp.sum(ssum, 0) / n
-        mean_sq = jnp.sum(sqsum, 0) / n
+        # Moments over the leading axes per PACKED channel (convert-free
+        # backward — layers.bn_moments), then averaged over the pack groups
+        # (equal group sizes: mean of group means == pooled mean).
+        from mpi4dl_tpu.ops.layers import bn_moments
+
+        m_pc, msq_pc = bn_moments(x)
+        mean = m_pc.reshape(self.pack, c).mean(0)
+        mean_sq = msq_pc.reshape(self.pack, c).mean(0)
         if self.reduce_axes:
             mean = lax.pmean(mean, self.reduce_axes)
             mean_sq = lax.pmean(mean_sq, self.reduce_axes)

@@ -320,7 +320,22 @@ class Trainer:
             shapes.append(2.0 * tree_bytes(h) * len(run))
             for k in run:
                 h = jax.eval_shape(self.cells[k].apply, params[k], h)
-        order = sorted(range(len(shapes)), key=lambda i: shapes[i])
+        # Grant order (MPI4DL_TPU_SAVE_ORDER): "small" (default) packs the
+        # most runs under the budget — late high-channel stages, the best
+        # FLOPs-avoided-per-byte; "big" spends it on the early high-
+        # resolution stages instead, whose absolute recompute time is
+        # largest. An A/B lever for the >=2048px regime where the full
+        # save set exceeds the compile-helper wall.
+        order_pref = os.environ.get("MPI4DL_TPU_SAVE_ORDER", "small")
+        if order_pref not in ("small", "big"):
+            raise ValueError(
+                f"MPI4DL_TPU_SAVE_ORDER must be small|big, got {order_pref!r}"
+            )
+        order = sorted(
+            range(len(shapes)),
+            key=lambda i: shapes[i],
+            reverse=order_pref == "big",
+        )
         budget = budget_mb * 1e6
         ckpts = [jax.checkpoint] * len(shapes)
         for i in order:

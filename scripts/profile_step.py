@@ -26,6 +26,9 @@ def capture(args):
 
     from mpi4dl_tpu.config import ParallelConfig
     from mpi4dl_tpu.train import Trainer
+    from mpi4dl_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()  # share bench.py's warm persistent cache
 
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
     if args.model == "resnet":

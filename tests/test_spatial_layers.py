@@ -94,6 +94,155 @@ def test_spatial_pool_matches_sequential(kind, kernel, stride, padding):
     np.testing.assert_allclose(out, golden, rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "kernel,stride,padding,shape",
+    [
+        ((3, 3), (2, 2), (1, 1), (2, 16, 16, 3)),
+        ((2, 2), (2, 2), (0, 0), (2, 16, 16, 3)),
+        ((3, 3), (2, 2), (1, 1), (1, 15, 17, 5)),  # odd extents
+        ((3, 2), (2, 3), (1, 0), (2, 12, 18, 4)),  # rectangular
+    ],
+)
+def test_max_pool_strided_backward_matches_select_and_scatter(
+    kernel, stride, padding, shape
+):
+    """The decomposed strided-pool backward (ops/layers.py
+    ``max_pool_strided``) claims BIT-IDENTICAL semantics to XLA's
+    ``select_and_scatter`` (first max in row-major window order wins the
+    gradient). Proven here on tie-HEAVY data — small integers, so most
+    windows contain duplicated maxima and any tie-breaking difference
+    shows up immediately."""
+    from mpi4dl_tpu.ops.layers import max_pool_strided
+
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    rng = np.random.default_rng(7)
+    # Integer values 0..3: ties everywhere.
+    x = jnp.asarray(rng.integers(0, 4, size=shape), jnp.float32)
+
+    def via_decomposed(x):
+        y = max_pool_strided(x, kh, kw, sh, sw, ph, pw)
+        return jnp.sum(y * jnp.cos(jnp.arange(y.size, dtype=y.dtype)).reshape(y.shape))
+
+    def via_xla(x):
+        import flax.linen as nn
+
+        y = nn.max_pool(
+            x, (kh, kw), strides=(sh, sw), padding=((ph, ph), (pw, pw))
+        )
+        return jnp.sum(y * jnp.cos(jnp.arange(y.size, dtype=y.dtype)).reshape(y.shape))
+
+    v1, g1 = jax.value_and_grad(via_decomposed)(x)
+    v2, g2 = jax.value_and_grad(via_xla)(x)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    # Gradient ROUTING must be identical; the only tolerated difference is
+    # f32 summation order where several windows hit one input element
+    # (~1e-7). A tie-breaking divergence would misroute whole dy values
+    # (magnitude ~1) and fail this bound by 6 orders.
+    np.testing.assert_allclose(
+        np.asarray(g1), np.asarray(g2), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("spatial", [False, True])
+def test_pool_decomposed_backward_dispatch(spatial, monkeypatch):
+    """MPI4DL_TPU_POOL_BWD=decomposed through the Pool MODULE (the pad
+    plumbing and the spatial halo-exchange + trim composition, which the
+    direct max_pool_strided parity test bypasses): value AND input
+    gradient must match the default-impl Pool exactly."""
+    from mpi4dl_tpu.ops.layers import Pool
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.integers(0, 4, size=(2, 16, 16, 3)), jnp.float32)
+    pool_kw = dict(kind="max", kernel_size=3, strides=2, padding=1)
+    mesh = _mesh(2, 2) if spatial else None
+
+    def run(impl):
+        monkeypatch.setenv("MPI4DL_TPU_POOL_BWD", impl)
+        plain = Pool(**pool_kw)
+        params = plain.init(jax.random.PRNGKey(0), x)
+        if not spatial:
+            def loss(x):
+                y = plain.apply(params, x)
+                return jnp.sum(y * jnp.cos(
+                    jnp.arange(y.size, dtype=y.dtype)).reshape(y.shape))
+
+            return jax.value_and_grad(loss)(x)
+
+        sp = Pool(**pool_kw, spatial=True)
+
+        @jax.jit
+        def loss(x):
+            from jax import shard_map
+            from jax.sharding import PartitionSpec
+
+            def local(xt):
+                y = sp.apply(params, xt)
+                # Position-dependent weights: a mis-padded/mis-trimmed
+                # backward would route gradient to the wrong inputs and
+                # diverge from the default impl immediately.
+                w = jnp.cos(jnp.arange(y.size, dtype=y.dtype)).reshape(y.shape)
+                return jax.lax.psum(jnp.sum(y * w), ("tile_h", "tile_w"))
+
+            f = shard_map(
+                local, mesh=mesh,
+                in_specs=SPEC, out_specs=PartitionSpec(),
+                check_vma=False,
+            )
+            return f(x)
+
+        return jax.value_and_grad(loss)(x)
+
+    v_dec, g_dec = run("decomposed")
+    v_xla, g_xla = run("xla")
+    np.testing.assert_array_equal(np.asarray(v_dec), np.asarray(v_xla))
+    np.testing.assert_allclose(
+        np.asarray(g_dec), np.asarray(g_xla), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_bn_fused_backward_matches_stock_ad(monkeypatch):
+    """The MPI4DL_TPU_BN_BWD=fused lever's hand-derived backward
+    (``dx = x·(2·ct_sq/n) + ct_mean/n``) must equal stock AD — checked
+    through a full TrainBatchNorm apply (scale/bias gradients included),
+    which is how every model reaches bn_moments."""
+    from mpi4dl_tpu.ops.layers import TrainBatchNorm
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 5)), jnp.float32)
+    bn = TrainBatchNorm()
+    params = bn.init(jax.random.PRNGKey(0), x)
+
+    def grads(impl):
+        monkeypatch.setenv("MPI4DL_TPU_BN_BWD", impl)
+
+        def loss(params, x):
+            y = bn.apply(params, x)
+            w = jnp.cos(jnp.arange(y.size, dtype=y.dtype)).reshape(y.shape)
+            return jnp.sum(y * w)
+
+        (v, gx), gp = (
+            jax.value_and_grad(loss, argnums=1)(params, x),
+            jax.grad(loss, argnums=0)(params, x),
+        )
+        return v, gx, gp
+
+    v_f, gx_f, gp_f = grads("fused")
+    v_x, gx_x, gp_x = grads("xla")
+    np.testing.assert_allclose(float(v_f), float(v_x), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(gx_f), np.asarray(gx_x), rtol=1e-5, atol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        gp_f,
+        gp_x,
+    )
+
+
 def test_spatial_window_coverage_check():
     """Spatial windowed ops whose halo can't cover cross-boundary windows
     must fail loudly instead of silently dropping windows."""
