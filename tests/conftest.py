@@ -18,6 +18,18 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache for the suite itself: the fast tier's wall
+# time is dominated by CPU XLA compiles of the golden train steps, which
+# are identical from run to run. Keyed by program+platform, so correctness
+# is jax's concern, not ours; a cold run warms it (~7 min), warm reruns of
+# the fast tier fit the <5-minute CI window (measured — README "Testing").
+from mpi4dl_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache(
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".cache", "jax-cpu-tests")
+)
+
 # Golden-parity tests compare distributed (tile-local shapes) against
 # single-device (full-image) runs; the MXU-packed conv picks pack factors
 # from local shapes, so the two sides could legally differ in f32

@@ -46,6 +46,7 @@ def test_amoebanet_deeper_variant():
     assert shapes[-1] == (1, 100)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_spatial", [3])
 def test_amoebanet_spatial_forward_matches_plain(n_spatial):
     """Spatial cells (halo-exchange convs/pools, incl. the

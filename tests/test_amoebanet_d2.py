@@ -82,6 +82,7 @@ def test_amoebanet_d2_forward_matches_plain(n_spatial):
     )
 
 
+@pytest.mark.slow
 def test_amoebanet_d2_gradients_match_plain():
     """Gradient parity through the D2 cell (crops, custom boundary fills and
     interior-masked BN all under AD)."""

@@ -47,6 +47,7 @@ def _json_lines(out):
     return [json.loads(l) for l in lines]
 
 
+@pytest.mark.slow
 def test_amoebanet_headline_line_shape(cache_dir):
     out = _run(cache_dir, {"BENCH_MODEL": "amoebanet"})
     assert out.returncode == 0, out.stderr[-2000:]
@@ -60,6 +61,7 @@ def test_amoebanet_headline_line_shape(cache_dir):
         assert "vs_baseline" in r
 
 
+@pytest.mark.slow
 def test_resnet_headline(cache_dir):
     out = _run(cache_dir, {"BENCH_MODEL": "resnet"})
     assert out.returncode == 0, out.stderr[-2000:]
@@ -69,6 +71,7 @@ def test_resnet_headline(cache_dir):
     assert records[0]["vs_baseline"] is not None
 
 
+@pytest.mark.slow
 def test_budget_exhaustion_skips_extras_but_keeps_headline(cache_dir):
     # BENCH_MODEL=all on CPU: amoebanet headline + one resnet extra. A
     # 1-second budget cannot erase the headline (the budget gates extras

@@ -1,0 +1,115 @@
+"""End-to-end CLI smoke tests for all 8 training entry scripts.
+
+The trainer classes are golden-tested (test_pipeline/test_train); what those
+tests never touch is the scripts' argument plumbing — ``benchmarks/common.py``
+routing (build_config/build_resnet/build_amoebanet/make_trainer) driven by
+real argparse vectors. The reference's de-facto integration surface is
+exactly these scripts (``/root/reference/benchmarks/*/benchmark_*.py``,
+SURVEY.md §2.3); here each one runs 1-2 real steps in-process on the 8
+virtual CPU devices (conftest), covering the VERDICT-r3 flag matrix:
+``--halo-D2``, ``--local-DP 4``, GEMS+SP, ``--enable-master-comm-opt``,
+``--eval-batches``, and ``--times 2``.
+"""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+# Every case compiles a full model on the CPU mesh — minutes each. The fast
+# tier's engine coverage lives in the golden tests; these are the
+# integration layer.
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+B = os.path.join(REPO, "benchmarks")
+
+# Tiny-but-real configs: ResNet scripts always build ResNet-110 (the
+# reference hardcodes resnet_n=12 the same way), so they run @32px with 1-2
+# steps; AmoebaNet scripts get shrunk via their own CLI (--num-layers /
+# --num-filters — same knobs the reference exposes).
+_COMMON = ["--image-size", "32", "--precision", "fp32", "--verbose"]
+_AMOEBA_SMALL = ["--num-layers", "3", "--num-filters", "32"]
+
+CASES = {
+    "layer_parallelism/benchmark_resnet_lp.py": [
+        "--batch-size", "4", "--parts", "2", "--split-size", "2",
+        "--max-steps", "2", "--eval-batches", "1", *_COMMON,
+    ],
+    "layer_parallelism/benchmark_amoebanet_lp.py": [
+        "--batch-size", "4", "--parts", "2", "--split-size", "2",
+        "--max-steps", "2", *_AMOEBA_SMALL, "--image-size", "64",
+        "--precision", "fp32", "--verbose",
+    ],
+    # --halo-D2: the fused-halo D2 spatial model through the full script.
+    "spatial_parallelism/benchmark_resnet_sp.py": [
+        "--batch-size", "2", "--parts", "1", "--split-size", "2",
+        "--spatial-size", "1", "--num-spatial-parts", "4",
+        "--slice-method", "square", "--halo-D2", "--fused-layers", "2",
+        "--max-steps", "2", *_COMMON,
+    ],
+    # --local-DP 4: LBANN-style DP inside the LP stages after SP (8 devices).
+    "spatial_parallelism/benchmark_amoebanet_sp.py": [
+        "--batch-size", "8", "--parts", "1", "--split-size", "2",
+        "--spatial-size", "1", "--num-spatial-parts", "4",
+        "--slice-method", "square", "--local-DP", "4",
+        "--max-steps", "2", *_AMOEBA_SMALL, "--image-size", "64",
+        "--precision", "fp32", "--verbose",
+    ],
+    # --times 2: the GEMS effective-batch knob beyond its default.
+    "gems_master_model/benchmark_resnet_gems_master.py": [
+        "--batch-size", "2", "--parts", "2", "--split-size", "2",
+        "--times", "2", "--max-steps", "2", *_COMMON,
+    ],
+    "gems_master_model/benchmark_amoebanet_gems_master.py": [
+        "--batch-size", "2", "--parts", "2", "--split-size", "2",
+        "--enable-master-comm-opt", "--max-steps", "2",
+        *_AMOEBA_SMALL, "--image-size", "64", "--precision", "fp32",
+        "--verbose",
+    ],
+    # GEMS+SP: spatial front + bidirectional pipeline (ref two-MPIComm path).
+    "gems_master_with_spatial_parallelism/benchmark_resnet_gems_master_with_sp.py": [
+        "--batch-size", "2", "--parts", "2", "--split-size", "3",
+        "--spatial-size", "1", "--num-spatial-parts", "4",
+        "--slice-method", "square", "--max-steps", "2", *_COMMON,
+    ],
+    "gems_master_with_spatial_parallelism/benchmark_amoebanet_gems_master_with_sp.py": [
+        "--batch-size", "2", "--parts", "2", "--split-size", "3",
+        "--spatial-size", "1", "--num-spatial-parts", "4",
+        "--slice-method", "square", "--enable-master-comm-opt",
+        "--max-steps", "2", *_AMOEBA_SMALL, "--image-size", "64",
+        "--precision", "fp32", "--verbose",
+    ],
+}
+
+
+@pytest.mark.parametrize("script", sorted(CASES), ids=lambda s: s.split("/")[-1])
+def test_cli_script_smoke(script, monkeypatch, capsys):
+    """Run the script's real __main__ path with a real argv; assert it
+    trains (per-step loss lines via --verbose) and reports throughput."""
+    # The scripts' apply_platform_env honors JAX_PLATFORMS — which this
+    # container exports as "axon" (the real TPU). Point it at the CPU
+    # simulation, exactly as the scripts' own usage message instructs.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # ResNet-20 instead of ResNet-110: the scripts' plumbing (what this
+    # test covers) is depth-independent, and the 54-cell CPU compile is
+    # not a cost 8 parametrized smoke runs should pay.
+    monkeypatch.setenv("MPI4DL_TPU_RESNET_N", "2")
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8",
+    )
+    monkeypatch.setattr(
+        sys, "argv", [os.path.basename(script)] + CASES[script]
+    )
+    runpy.run_path(os.path.join(B, script), run_name="__main__")
+    out = capsys.readouterr().out
+    assert "loss" in out, out  # --verbose per-step line → a step really ran
+    assert "img/s" in out, out  # the end-of-run throughput report
+    if "--enable-master-comm-opt" in CASES[script]:
+        # CLI parity: the flag is accepted and explained, not ignored.
+        assert "comm-opt" in out, out
+    if "--eval-batches" in CASES[script]:
+        assert "eval (" in out, out

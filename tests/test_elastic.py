@@ -59,6 +59,7 @@ def test_supervise_gives_up_after_max_restarts(tmp_path):
     assert any("giving up after 2 restart(s)" in m for m in msgs)
 
 
+@pytest.mark.slow
 def test_supervise_kills_wedged_child_on_stale_heartbeat(tmp_path, monkeypatch):
     hb = tmp_path / "heartbeat"
     w = _worker(
@@ -104,6 +105,7 @@ def test_maybe_supervise_noop_without_flag_or_in_child(monkeypatch):
     elastic.maybe_supervise(A())  # child: also a no-op
 
 
+@pytest.mark.slow
 def test_benchmark_crash_resume_end_to_end(tmp_path):
     """Real path: benchmark_resnet_lp crash-injected at step 2 restarts
     under --max-restarts and resumes from the step-2 checkpoint."""

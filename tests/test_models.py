@@ -32,7 +32,11 @@ def test_get_depth_parity():
     assert get_depth(2, 6) == 56
 
 
-@pytest.mark.parametrize("version,n", [(1, 2), (2, 2)])
+@pytest.mark.parametrize(
+    "version,n",
+    [pytest.param(1, 2, marks=pytest.mark.slow),
+     pytest.param(2, 2, marks=pytest.mark.slow)],
+)
 def test_resnet_shapes(version, n):
     depth = get_depth(version, n)
     cells = (get_resnet_v1 if version == 1 else get_resnet_v2)(depth, num_classes=10)

@@ -26,6 +26,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_world_trains():
     port = _free_port()
     env = dict(os.environ)
@@ -55,6 +56,10 @@ def test_two_process_world_trains():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"proc {pid}: ALL OK" in out, f"worker {pid} output:\n{out}"
+        # The spatial world (data across hosts, tiles host-local) and the
+        # placement-contract rejection both ran (VERDICT r3 #8).
+        assert f"proc {pid}: DPxSP case OK" in out, f"worker {pid}:\n{out}"
+        assert f"proc {pid}: rejection case OK" in out, f"worker {pid}:\n{out}"
     # Both hosts must observe identical losses (one SPMD program).
     import re
 

@@ -80,7 +80,11 @@ def _run_and_compare(trainer, steps=2, batch_seed=0, rtol=2e-4, atol=1e-5,
     )
 
 
-@pytest.mark.parametrize("parts", [1, 2, 4])
+@pytest.mark.parametrize(
+    "parts",
+    [pytest.param(1, marks=pytest.mark.slow), 2,
+     pytest.param(4, marks=pytest.mark.slow)],
+)
 def test_lp_pipeline_matches_golden(parts):
     """Plain LP/PP: 2 stages, varying micro-batch counts (ref `--parts`)."""
     cfg = ParallelConfig(
@@ -91,6 +95,7 @@ def test_lp_pipeline_matches_golden(parts):
     _run_and_compare(trainer)
 
 
+@pytest.mark.slow
 def test_lp_pipeline_balance_and_4_stages():
     """Uneven user balance over 4 stages (ref `--balance`)."""
     cfg = ParallelConfig(
@@ -106,6 +111,7 @@ def test_lp_pipeline_balance_and_4_stages():
     _run_and_compare(trainer)
 
 
+@pytest.mark.slow
 def test_dp_lp_pipeline():
     """DP=2 x 2 stages: gradient reduction across replicas composes with the
     pipeline schedule."""
@@ -122,10 +128,12 @@ def test_dp_lp_pipeline():
     "slice_method,parts_sp,split,depth,parts",
     [
         ("square", 4, 2, 8, 2),  # front + single LP stage (4 devices)
-        ("vertical", 2, 2, 8, 2),
-        ("square", 4, 3, 14, 2),  # front + 2-stage LP pipeline (8 devices),
-        #   parts % lp == 0 → front micro-batches shard over the pipe axis
-        ("square", 4, 3, 14, 3),  # parts % lp != 0 → replicated-front path
+        pytest.param("vertical", 2, 2, 8, 2, marks=pytest.mark.slow),
+        # front + 2-stage LP pipeline (8 devices), parts % lp == 0 →
+        # front micro-batches shard over the pipe axis
+        pytest.param("square", 4, 3, 14, 2, marks=pytest.mark.slow),
+        # parts % lp != 0 → replicated-front path
+        pytest.param("square", 4, 3, 14, 3, marks=pytest.mark.slow),
     ],
 )
 def test_sp_lp_pipeline(slice_method, parts_sp, split, depth, parts):
@@ -239,6 +247,7 @@ def _run_and_compare_local_dp(trainer, steps=2):
     )
 
 
+@pytest.mark.slow
 def test_local_dp_lp_matches_golden():
     """LOCAL_DP_LP (ref ``train_spatial.py:809-1028``): with ``--local-DP``,
     the post-join LP stages batch-shard over the 4 tile devices (each
@@ -263,6 +272,7 @@ def test_local_dp_lp_matches_golden():
     _run_and_compare_local_dp(trainer)
 
 
+@pytest.mark.slow
 def test_local_dp_lp_with_gems():
     """LOCAL_DP_LP composes with the GEMS bidirectional schedule."""
     cfg = ParallelConfig(
@@ -284,6 +294,7 @@ def test_local_dp_lp_with_gems():
     _run_and_compare_local_dp(trainer)
 
 
+@pytest.mark.slow
 def test_skewed_multistage_sp_matches_golden():
     """Skewed multi-stage SP (ref ``--num-spatial-parts 4,2``,
     ``train_spatial.py:453-641``): two spatial stages with decreasing part
@@ -322,6 +333,7 @@ def test_skewed_sp_validation():
     ParallelConfig(num_spatial_parts=(4, 2), **base)  # valid
 
 
+@pytest.mark.slow
 def test_mirror_pipeline_matches_golden():
     """GEMS_INVERSE placement: stage s on pipe device S-1-s, wire flow
     reversed (ref ``mp_pipeline.py:238-248``) — must be numerically identical
@@ -334,7 +346,14 @@ def test_mirror_pipeline_matches_golden():
     _run_and_compare(trainer)
 
 
-@pytest.mark.parametrize("times", [1, 2, 4])
+@pytest.mark.parametrize(
+    "times",
+    [
+        1,
+        pytest.param(2, marks=pytest.mark.slow),
+        pytest.param(4, marks=pytest.mark.slow),
+    ],
+)
 def test_gems_master_matches_golden(times):
     """GEMS-MASTER: 2*times alternating normal/mirrored chunks with one
     parameter copy (mirror ppermute of stage rows) must equal the golden
@@ -351,6 +370,50 @@ def test_gems_master_matches_golden(times):
     _run_and_compare(trainer)
 
 
+def test_gems_times_constant_program_size():
+    """The GEMS chunk loop is a ``lax.scan`` over normal/mirror pairs
+    (``GemsMasterTrainer._local_loss``): the traced program must contain
+    exactly two pipeline schedules regardless of ``--times`` — the
+    reference's effective-batch knob (``gems_master.py:72-103``) must be
+    free to raise. Proof: the train-step jaxpr has an IDENTICAL equation
+    count for times=1 and times=4 (only the scan length — a shape — may
+    differ). Golden parity at times=4 is test_gems_master_matches_golden."""
+
+    def count_eqns(jaxpr) -> int:
+        n = 0
+        for eqn in jaxpr.eqns:
+            n += 1
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for item in vals:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        n += count_eqns(inner)
+                    elif hasattr(item, "eqns"):
+                        n += count_eqns(item)
+        return n
+
+    counts = {}
+    for times in (1, 4):
+        cfg = ParallelConfig(
+            batch_size=4, parts=2, split_size=2, spatial_size=0,
+            image_size=32, times=times,
+        )
+        cells = get_resnet_v1(depth=8)
+        trainer = GemsMasterTrainer(cells, cfg)
+        state = trainer.init(jax.random.PRNGKey(0))
+        x, y = _batch(trainer.chunks * cfg.batch_size, cfg.image_size)
+        xs, ys = trainer.shard_batch(x, y)
+        jaxpr = jax.make_jaxpr(trainer._train_step)(state, xs, ys)
+        counts[times] = count_eqns(jaxpr.jaxpr)
+
+    assert counts[1] == counts[4], (
+        f"program size grew with --times: {counts} — the chunk loop is "
+        "no longer a constant-size scan"
+    )
+
+
+@pytest.mark.slow
 def test_gems_master_with_spatial():
     """SP+GEMS (ref ``train_spatial_master.py``): spatial front + both pipe
     directions, composing without the reference's rank-disjointness
@@ -373,6 +436,7 @@ def test_gems_master_with_spatial():
     _run_and_compare(trainer)
 
 
+@pytest.mark.slow
 def test_five_d_parallelism_matches_golden():
     """The reference's headline "5D parallelism" (README.md:90-101) composed
     in ONE jitted SPMD program over the 8 virtual devices: Spatial (vertical
@@ -431,6 +495,7 @@ def _amoeba(spatial_cells=0):
     )
 
 
+@pytest.mark.slow
 def test_amoebanet_lp_pipeline_matches_golden():
     """Plain LP: the stage-boundary wires carry (concat, skip) tuples."""
     cfg = ParallelConfig(
@@ -450,6 +515,7 @@ def test_amoebanet_lp_pipeline_matches_golden():
     _run_and_compare(trainer, rtol=2e-2, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_amoebanet_sp_lp_pipeline_matches_golden():
     """SP front (2x2 tiles, halo-exchanged cells) + LP back with tuple wires."""
     cfg = ParallelConfig(
@@ -469,6 +535,7 @@ def test_amoebanet_sp_lp_pipeline_matches_golden():
     _run_and_compare(trainer, rtol=2e-2, atol=1e-4, loss_rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_amoebanet_gems_matches_golden():
     """GEMS mirror pairs with tuple wires (ref train_spatial_master lineage)."""
     cfg = ParallelConfig(

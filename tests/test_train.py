@@ -32,7 +32,10 @@ def _assert_tree_close(a, b, **kw):
     )
 
 
-@pytest.mark.parametrize("slice_method,parts", [("square", 4), ("vertical", 4)])
+@pytest.mark.parametrize(
+    "slice_method,parts",
+    [("square", 4), pytest.param("vertical", 4, marks=pytest.mark.slow)],
+)
 def test_resnet_spatial_trainer_matches_single_device(slice_method, parts):
     cfg = ParallelConfig(
         batch_size=4,
@@ -69,6 +72,7 @@ def test_resnet_spatial_trainer_matches_single_device(slice_method, parts):
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_dp_plus_sp_trainer_matches_golden():
     """DP=2 × 2×2 tiles (all 8 virtual devices). BN-free cells so per-shard
     batch statistics can't mask a gradient-reduction bug."""
@@ -131,6 +135,7 @@ def test_pure_dp_no_spatial():
     _assert_tree_close(state.params, golden_state.params, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("remat", ["cell", "sqrt", "scan", "scan_save", "group_save"])
 def test_remat_policies_match_golden(remat):
     """Every remat policy is a pure scheduling choice: losses, metrics, and
@@ -158,6 +163,7 @@ def test_remat_policies_match_golden(remat):
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_scan_unroll_matches_golden(monkeypatch):
     """MPI4DL_TPU_SCAN_UNROLL amortizes scan machinery without changing
     numerics: an unrolled scan run must equal the no-remat golden exactly
@@ -167,6 +173,7 @@ def test_scan_unroll_matches_golden(monkeypatch):
     test_remat_policies_match_golden("scan_save")
 
 
+@pytest.mark.slow
 def test_scan_remat_spatial_matches_golden():
     """The "scan" policy composes with a spatial front: runs never span the
     SP→LP join and spatial (halo-exchanging) repeated cells scan inside
@@ -219,6 +226,7 @@ def test_local_dp_without_lp_stage_rejected():
         )
 
 
+@pytest.mark.slow
 def test_scan_remat_amoebanet_tuple_state_matches_golden():
     """The "scan" planner accepts pytree (tuple-state) fixed points: an
     AmoebaNet run of identical normal cells rewrites into one stacked-param
@@ -263,6 +271,7 @@ def test_scan_remat_amoebanet_tuple_state_matches_golden():
             np.testing.assert_allclose(u / scale, v / scale, atol=3e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("remat", [False, "scan_save"])
 def test_packed_layout_matches_golden(remat):
     """The persistently-packed activation layout (ops/packed.py) is a pure
@@ -299,6 +308,7 @@ def test_packed_layout_matches_golden(remat):
     _assert_tree_close(state.params, golden_state.params, rtol=5e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_packed_spatial_matches_golden():
     """Packed layout under spatial partitioning (round-2 VERDICT #4): the
     packed conv's zero-pad columns become halo-exchanged packed columns
@@ -341,7 +351,9 @@ def test_packed_spatial_matches_golden():
     _assert_tree_close(state.params, golden_state.params, rtol=5e-3, atol=1e-4)
 
 
-@pytest.mark.parametrize("accum", [2, 4])
+@pytest.mark.parametrize(
+    "accum", [pytest.param(2, marks=pytest.mark.slow), 4]
+)
 def test_grad_accum_matches_golden(accum):
     """grad_accum=k applies the MEAN of k per-chunk gradients in one
     update, each chunk a batch-of-B/k forward (own BN statistics — the
@@ -396,6 +408,7 @@ def test_grad_accum_rejects_indivisible_batch():
         trainer.train_step(state, xs, ys)
 
 
+@pytest.mark.slow
 def test_save_budget_matches_golden(monkeypatch):
     """MPI4DL_TPU_SAVE_BUDGET_MB only changes which runs save conv outputs
     (a scheduling choice) — params/metrics must match the no-remat golden
@@ -420,6 +433,7 @@ def test_save_budget_matches_golden(monkeypatch):
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_save_budget_spatial_matches_golden(monkeypatch):
     """The save-budget estimator must account for the SP→LP tile merge
     (join shapes are 4x the per-tile walk on a 2x2 grid) and still produce
