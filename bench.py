@@ -514,13 +514,18 @@ def main():
             if prior.get("value") is not None:
                 record(2048, prior["value"])
             for size in (4096, 8192):
+                # ≥4096px: the nested-scan policy — under plain "scan" the
+                # stored carries alone (~16 GB at 4096) exceed HBM and the
+                # remote-compile helper dies at buffer assignment
+                # (docs/PERF.md round 4). BENCH_REMAT still overrides.
+                walk_remats = [remat_pref] if remat_pref else ["scan2"]
                 # Key covers everything that shapes the compiled program —
                 # a different layout/dtype/policy A/B must not be skipped
                 # on another config's verdict.
                 from mpi4dl_tpu.train import scan_unroll
 
                 key = (
-                    f"resnet110_{size}px_bs1_{'-'.join(big_remats)}"
+                    f"resnet110_{size}px_bs1_{'-'.join(walk_remats)}"
                     f"_{layout}_{jnp.dtype(dtype).name}_u{scan_unroll()}"
                 )
                 skip = sentinel_skip_reason(
@@ -570,10 +575,8 @@ def main():
                 }
                 write_sentinel()
                 try:
-                    # big_remats: the only policies that fit >=2048px
-                    # (PERF.md r3); honors a BENCH_REMAT override.
                     ips, _ = _train_throughput(
-                        cells, size, 1, 3, 1, dtype, big_remats
+                        cells, size, 1, 3, 1, dtype, walk_remats
                     )
                 except Exception as e:  # noqa: BLE001 — walk stops here
                     msg = f"{type(e).__name__}: {str(e)[:120]}"

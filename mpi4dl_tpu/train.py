@@ -57,6 +57,12 @@ def _conv_save_ckpt():
     )
 
 
+def _no_ckpt(fn):
+    """The no-checkpoint tier of :meth:`Trainer._nockpt_grants`: residuals
+    stored, nothing replayed."""
+    return fn
+
+
 def scan_unroll() -> int:
     """Resolved lax.scan unroll factor for scanned cell runs (default 3,
     ``MPI4DL_TPU_SCAN_UNROLL`` overrides — measurements in the
@@ -123,7 +129,11 @@ class Trainer:
         """remat: False = store everything; True/"cell" = ``jax.checkpoint``
         per cell; "sqrt" = nested two-level remat (cells grouped into ~√N
         outer checkpoints, each cell checkpointed inside, so live residuals
-        are ~2√N boundaries); "scan" = the high-resolution workhorse:
+        are ~2√N boundaries); "scan2" = "scan" with the same two-level
+        nesting applied INSIDE each scan run (see :meth:`_scan_nested`) —
+        carry storage drops from one boundary per cell to ~2√n per run, the
+        policy that fits ≥4096px on one chip; "scan" = the high-resolution
+        workhorse:
 
         - consecutive cells with identical parameter structure and
           input==output shape (a ResNet stage's repeated blocks) run under
@@ -145,11 +155,11 @@ class Trainer:
         if num_spatial_cells > 0 and plain_cells is None:
             raise ValueError("spatial models need plain_cells for initialization")
         if remat not in (
-            False, True, "cell", "sqrt", "scan", "scan_save", "cell_save",
-            "group_save",
+            False, True, "cell", "sqrt", "scan", "scan2", "scan_save",
+            "cell_save", "group_save",
         ):
             raise ValueError(
-                "remat must be False, True, 'cell', 'sqrt', 'scan', "
+                "remat must be False, True, 'cell', 'sqrt', 'scan', 'scan2', "
                 f"'scan_save', 'cell_save' or 'group_save', got {remat!r}"
             )
         if grad_accum < 1:
@@ -264,7 +274,7 @@ class Trainer:
         (tagged ``conv_out`` by ``FastConv``), so the backward recomputes
         only the elementwise/BN segments between convs — +25% conv FLOPs
         avoided for ~the activations' footprint in HBM."""
-        key = (tuple(x.shape), x.dtype)
+        key = (tuple(x.shape), x.dtype, self.remat)
         if getattr(self, "_scan_plan_key", None) != key:
             if self.remat == "cell_save":
                 # "cell_save": per-cell checkpoints with conv-output saves,
@@ -295,10 +305,15 @@ class Trainer:
                 ckpts = self._budgeted_ckpts(params, x, budget_mb, save_ckpt)
             else:
                 ckpts = [save_ckpt] * len(self._scan_plan)
+            ckpts = self._nockpt_grants(params, x, ckpts)
             with save_conv_outputs():
                 return self._apply_scan_plan(params, x, ckpts)
         return self._apply_scan_plan(
-            params, x, [jax.checkpoint] * len(self._scan_plan)
+            params,
+            x,
+            self._nockpt_grants(
+                params, x, [jax.checkpoint] * len(self._scan_plan)
+            ),
         )
 
     def _budgeted_ckpts(self, params, x, budget_mb: float, save_ckpt):
@@ -342,6 +357,60 @@ class Trainer:
             if shapes[i] <= budget:
                 ckpts[i] = save_ckpt
                 budget -= shapes[i]
+        return ckpts
+
+    def _nockpt_grants(self, params, x, ckpts):
+        """Third remat tier (``MPI4DL_TPU_NOCKPT_BUDGET_MB``, default off):
+        runs whose FULL residual set fits the budget run with NO checkpoint
+        at all — their backward replays nothing. Rationale: the AmoebaNet
+        profile (docs/PERF.md round 4) shows the step is elementwise/HBM-
+        bound, not FLOPs-bound, and checkpointing makes the backward re-run
+        exactly those elementwise chains; the late stages' residuals are
+        small (pixels shrink 4x per reduction while channels only double,
+        so per-stage bytes HALVE), making them the cheapest recompute to
+        buy back. Residual bytes are estimated from the cell jaxpr (sum of
+        every equation output aval), cheapest runs first. Numerics are
+        identical — checkpointing is a scheduling choice."""
+        nockpt_mb = float(os.environ.get("MPI4DL_TPU_NOCKPT_BUDGET_MB", "0"))
+        if nockpt_mb <= 0:
+            return ckpts
+
+        def eqn_out_bytes(jaxpr) -> float:
+            total = 0.0
+            for eqn in jaxpr.eqns:
+                # Call-like equations (pjit / custom_vjp / remat wrappers):
+                # count ONLY the sub-jaxpr — the outer eqn's outvars are the
+                # sub-jaxpr's final outputs and would double-count.
+                subs = [
+                    val.jaxpr
+                    for val in eqn.params.values()
+                    if hasattr(val, "jaxpr")
+                ]
+                if subs:
+                    total += sum(eqn_out_bytes(j) for j in subs)
+                    continue
+                for v in eqn.outvars:
+                    aval = v.aval
+                    if hasattr(aval, "shape"):
+                        total += float(np.prod(aval.shape)) * aval.dtype.itemsize
+            return total
+
+        est = []
+        h = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        for run in self._scan_plan:
+            h = self._at_join(run[0], h)
+            i = run[0]
+            closed = jax.make_jaxpr(self.cells[i].apply)(params[i], h)
+            est.append(eqn_out_bytes(closed.jaxpr) * len(run))
+            for k in run:
+                h = jax.eval_shape(self.cells[k].apply, params[k], h)
+
+        budget = nockpt_mb * 1e6
+        ckpts = list(ckpts)
+        for i in sorted(range(len(est)), key=lambda i: est[i]):
+            if est[i] <= budget:
+                ckpts[i] = _no_ckpt
+                budget -= est[i]
         return ckpts
 
     @staticmethod
@@ -402,9 +471,55 @@ class Trainer:
             # matches unroll=3, so 3 is the default — the smallest program
             # that captures the win. MPI4DL_TPU_SCAN_UNROLL overrides.
             unroll = scan_unroll()
-            hc, _ = lax.scan(body, hc, stacked, unroll=unroll)
+            if (
+                self.remat == "scan2"
+                and len(run) >= 4
+                and ckpt is not _no_ckpt
+            ):
+                # A _nockpt_grants grant overrides the nesting: the whole
+                # point of the no-checkpoint tier is to store residuals and
+                # replay nothing, which the plain scan body below (with
+                # ckpt == _no_ckpt) does.
+                hc = self._scan_nested(hc, stacked, apply_compact, unroll)
+            else:
+                hc, _ = lax.scan(body, hc, stacked, unroll=unroll)
             h = self._restore(hc, shapes)
         return h
+
+    @staticmethod
+    def _scan_nested(hc, stacked, apply_compact, unroll):
+        """Two-level (~sqrt-depth) checkpointing over one scan run — the
+        "scan2" policy's heart. The run's n cells split into ~sqrt(n)-sized
+        chunks; an outer lax.scan carries only CHUNK boundaries and each
+        chunk is one jax.checkpoint whose backward re-runs its inner
+        (per-cell-checkpointed) scan. Live residuals drop from n cell
+        boundaries ("scan") to ~2*sqrt(n), at the price of one extra
+        forward recompute. This is what fits ResNet-110 @4096px bs=1 on one
+        16 GB chip: under "scan" the three stages' stored carries alone are
+        ~16 GB (18 x 512 MB + 18 x 256 MB + 18 x 128 MB, docs/PERF.md
+        round 4), which the tunneled runtime's remote-compile helper
+        rejects at buffer-assignment time — the 4096px "compile wall" was
+        an out-of-memory program, not a compiler defect."""
+        n = jax.tree.leaves(stacked)[0].shape[0]
+        g = max(2, int(round(n ** 0.5)))
+        m, rem = divmod(n, g)
+
+        def chunk(hc, ps):
+            def body(hc, p):
+                return jax.checkpoint(apply_compact)(p, hc), None
+
+            hc, _ = lax.scan(body, hc, ps, unroll=unroll)
+            return hc
+
+        chunk_ck = jax.checkpoint(chunk)
+        if rem:
+            head = jax.tree.map(lambda a: a[:rem], stacked)
+            hc = chunk_ck(hc, head)
+        tail = jax.tree.map(
+            lambda a: a[rem:].reshape((m, g) + a.shape[1:]), stacked
+        )
+        hc, _ = lax.scan(lambda hc, ps: (chunk_ck(hc, ps), None), hc, tail)
+        return hc
 
     def _apply_cells_remat(self, params, x):
         """Run all cells under the configured remat policy (inserting the
@@ -415,7 +530,7 @@ class Trainer:
                 h = jax.tree.map(gather_tiles, h)
             return self.cells[i].apply(p, h)
 
-        if self.remat in ("scan", "scan_save", "cell_save"):
+        if self.remat in ("scan", "scan2", "scan_save", "cell_save"):
             return self._apply_cells_scan(params, x)
         if self.remat in (True, "cell"):
             h = x

@@ -81,13 +81,18 @@ def main():
     ap.add_argument("--start", type=int, default=1024)
     ap.add_argument("--max", type=int, default=16384)
     args = ap.parse_args()
-    remats = (
-        ["scan_save", "scan"] if args.model == "amoebanet" else
-        ["cell_save", "scan_save", "scan"]
-    )
     peak = None
     size = args.start
     while size <= args.max:
+        if size >= 4096:
+            # Nested-scan is the only policy whose carries fit HBM here
+            # (see Trainer._scan_nested); larger sizes would waste a
+            # multi-minute doomed compile per leaner policy otherwise.
+            remats = ["scan2"]
+        elif args.model == "amoebanet":
+            remats = ["scan_save", "scan"]
+        else:
+            remats = ["cell_save", "scan_save", "scan"]
         # One size per SUBPROCESS: a failed compile can wedge the tunneled
         # runtime, which must not kill the whole walk.
         import subprocess

@@ -135,8 +135,37 @@ def test_pure_dp_no_spatial():
     _assert_tree_close(state.params, golden_state.params, rtol=1e-4, atol=1e-6)
 
 
+def test_scan2_nested_remat_matches_golden():
+    """The "scan2" policy (two-level checkpointing inside scan runs — the
+    ≥4096px memory policy) is a pure scheduling choice: depth-44 gives
+    7-cell runs, exercising BOTH the chunked outer scan (g=3, m=2) and the
+    remainder head-chunk path (rem=1); depth-20's 3-cell runs (below the
+    nesting threshold) are covered by the "scan" parametrization below."""
+    cells = get_resnet_v1(depth=44)
+    cfg = ParallelConfig(batch_size=2, split_size=1, spatial_size=0, image_size=32)
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat="scan2")
+    state = trainer.init(jax.random.PRNGKey(3), (2, 32, 32, 3))
+    _, golden_step = single_device_step(cells)
+    gp = jax.tree.map(jnp.copy, state.params)
+    golden_state = TrainState(
+        params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+    )
+    x, y = _batch(b=2, size=32)
+    for seed in (1, 2):
+        xs, ys = trainer.shard_batch(x, y)
+        state, metrics = trainer.train_step(state, xs, ys)
+        golden_state, golden_metrics = golden_step(golden_state, x, y)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+        )
+        x, y = _batch(b=2, size=32, seed=seed + 20)
+    _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
+
+
 @pytest.mark.slow
-@pytest.mark.parametrize("remat", ["cell", "sqrt", "scan", "scan_save", "group_save"])
+@pytest.mark.parametrize(
+    "remat", ["cell", "sqrt", "scan", "scan2", "scan_save", "group_save"]
+)
 def test_remat_policies_match_golden(remat):
     """Every remat policy is a pure scheduling choice: losses, metrics, and
     updated parameters must be identical to the no-remat golden step. "scan"
@@ -431,6 +460,37 @@ def test_save_budget_matches_golden(monkeypatch):
         float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
     )
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
+
+
+def test_nockpt_budget_matches_golden(monkeypatch):
+    """MPI4DL_TPU_NOCKPT_BUDGET_MB grants the cheapest runs a no-checkpoint
+    tier (residuals stored, nothing replayed in backward) — a pure
+    scheduling choice: params/metrics must match the no-remat golden. The
+    10 MB budget covers some-but-not-all depth-20 runs at 32px, exercising
+    the mixed grant path on both the saving and plain scan policies."""
+    monkeypatch.setenv("MPI4DL_TPU_NOCKPT_BUDGET_MB", "10")
+    for remat in ("scan_save", "scan"):
+        cells = get_resnet_v1(depth=20)
+        cfg = ParallelConfig(
+            batch_size=4, split_size=1, spatial_size=0, image_size=32
+        )
+        trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat=remat)
+        state = trainer.init(jax.random.PRNGKey(3), (4, 32, 32, 3))
+        _, golden_step = single_device_step(cells)
+        gp = jax.tree.map(jnp.copy, state.params)
+        golden_state = TrainState(
+            params=gp, opt_state=trainer.tx.init(gp), step=jnp.zeros((), jnp.int32)
+        )
+        x, y = _batch(b=4, size=32)
+        xs, ys = trainer.shard_batch(x, y)
+        state, metrics = trainer.train_step(state, xs, ys)
+        golden_state, golden_metrics = golden_step(golden_state, x, y)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
+        )
+        _assert_tree_close(
+            state.params, golden_state.params, rtol=2e-4, atol=1e-5
+        )
 
 
 @pytest.mark.slow
