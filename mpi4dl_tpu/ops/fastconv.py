@@ -41,6 +41,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
@@ -206,10 +207,15 @@ def _packed_dispatch(x, w, padding):
     if w.shape[0] == 1 and w.shape[1] == 1 and max(ph0, ph1, pw0, pw1) == 0:
         # 1x1 conv: a plain matmul over pixels. Layout packing can't help
         # (FLOP inflation exactly cancels the lane gain) but skipping the
-        # conv lowering measurably does.
-        b, h, ww, c = x.shape
-        y = x.reshape(-1, c) @ w.reshape(c, w.shape[3])
-        return y.reshape(b, h, ww, w.shape[3])
+        # conv lowering measurably does. Contract on the 4-D tensor
+        # directly — an explicit [B*H*W, C] reshape pins C as the minor
+        # (lane) dim, and for C < 128 XLA then materializes the operand
+        # padded up to 8x (measured: 2.25 GB for a 288 MB [3072^2, 16]
+        # reshape, part of the >2048px OOM — docs/PERF.md round 4); on
+        # 4-D operands the compiler keeps its own (H/W-minor) layouts.
+        return lax.dot_general(
+            x, w.reshape(w.shape[2], w.shape[3]), (((3,), (0,)), ((), ()))
+        )
     w_out = x.shape[2] + pw0 + pw1 - w.shape[1] + 1
     fh, fw = pack_factors(w.shape[0], w.shape[1], w.shape[3], w_out)
     if (fh, fw) == (1, 1):
@@ -231,20 +237,30 @@ def _conv2d_s1_bwd(padding, res, dy):
     kh, kw, _, _ = w.shape
     (ph0, ph1), (pw0, pw1) = padding
 
+    big = _wgrad_taps_profitable(
+        x.shape[0], x.shape[-1], float(np.prod(x.shape)) * x.dtype.itemsize
+    )
     # dx: full correlation with the flipped, io-swapped kernel — a stride-1
-    # small-N conv itself, so it goes through the packed dispatch too.
+    # small-N conv itself, so it goes through the packed dispatch too. In
+    # the big-size regime the W-packed dx form materializes an 8x-padded
+    # space-to-depth copy of dy (2.28 GB at 3072px — docs/PERF.md round
+    # 4); leave the lowering to XLA there.
     wt = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)  # [kh, kw, O, C]
     dx_pad = ((kh - 1 - ph0, kh - 1 - ph1), (kw - 1 - pw0, kw - 1 - pw1))
-    dx = _packed_dispatch(dy, wt, dx_pad)
+    dx = _conv_plain(dy, wt, (1, 1), dx_pad) if big else _packed_dispatch(
+        dy, wt, dx_pad
+    )
 
     # dw[u, v, c, o] = sum_{b,h,w} xp[b, h+u, w+v, c] * dy[b, h, w, o].
     # 1x1: that's a plain x^T @ dy dot over pixels — no conv machinery.
+    # Contract (B, H, W) on the 4-D operands directly (no [M, C] reshape —
+    # see the layout note in _packed_dispatch's 1x1 branch).
     if kh == 1 and kw == 1 and max(ph0, ph1, pw0, pw1) == 0:
         c, o = x.shape[-1], dy.shape[-1]
         dw = lax.dot_general(
-            x.reshape(-1, c),
-            dy.reshape(-1, o),
-            (((0,), (0,)), ((), ())),
+            x,
+            dy,
+            (((0, 1, 2), (0, 1, 2)), ((), ())),
             preferred_element_type=jnp.float32,
         ).reshape(1, 1, c, o)
         return dx.astype(x.dtype), dw.astype(w.dtype)
@@ -259,7 +275,8 @@ def _conv2d_s1_bwd(padding, res, dy):
 
     # k x k: the Pallas streaming kernel on TPU when the dispatch policy
     # admits the shape (see wgrad_impl_allows); fallback: the canonical
-    # "CHWN" backward-filter conv.
+    # "CHWN" backward-filter conv, row-folded when the plain form would
+    # materialize pathologically-padded operand copies (see wgrad_folded).
     from mpi4dl_tpu.ops import wgrad_pallas
 
     if (
@@ -269,15 +286,134 @@ def _conv2d_s1_bwd(padding, res, dy):
     ):
         dw = wgrad_pallas.wgrad(xt, dy, kh, kw)
     else:
-        dw = lax.conv_general_dilated(
-            xt,
-            dy,
-            window_strides=(1, 1),
-            padding="VALID",
-            dimension_numbers=("CHWN", "IHWO", "NHWC"),
-        )  # out: [C, kh, kw, O]
-        dw = dw.transpose(1, 2, 0, 3)
+        dw = wgrad_folded(xt, dy, kh, kw)
     return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+def _wgrad_taps_profitable(b: int, c: int, x_bytes: float) -> bool:
+    """True when the canonical backward-filter conv would materialize
+    pathologically-padded operand copies and the per-tap dot form should
+    be used instead.
+
+    The backward-filter conv maps x's BATCH axis to the conv feature
+    (lane) dim and x's CHANNEL axis to the conv batch (sublane) dim, so at
+    batch 1 / small C the TPU materializes x in a layout padded to
+    ~256/(B*C) times its logical bytes — measured 4.5 GB (16x) for a
+    288 MB [1,3072,3072,16] tensor, the allocation that made every
+    >2048px ResNet train step exceed HBM at compile (docs/PERF.md round
+    4; row-folding the batch was tried first and just moved the padding
+    into 5x-padded chunk copies). Gate: expansion >= 4 AND the padded
+    copy would exceed ``MPI4DL_TPU_WGRAD_TAPS_MIN_MB`` (default 256 —
+    small images pay kh*kw re-reads for nothing).
+    ``MPI4DL_TPU_WGRAD_TAPS`` = auto (default) | off.
+    """
+    if os.environ.get("MPI4DL_TPU_WGRAD_TAPS", "auto") == "off":
+        return False
+    min_mb = float(os.environ.get("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "256"))
+    expansion = 256.0 / (b * c)
+    return expansion >= 4.0 and x_bytes * expansion >= min_mb * 1e6
+
+
+def wgrad_taps(xt, dy, kh: int, kw: int, sh: int = 1, sw: int = 1):
+    """dw[u,v,c,o] = sum_{b,i,j} xt[b,i*sh+u,j*sw+v,c] * dy[b,i,j,o] as
+    kh*kw per-tap ``dot_general``s contracting (B, H, W) on plain 4-D
+    (strided) SLICES of the operands — no reshape, no transposed copy, so
+    XLA keeps its own (H/W-minor, unpadded) layouts for x and dy and the
+    only temporaries are one product at a time. This is what makes
+    >2048px train steps fit HBM; cost is kh*kw reads of x and dy.
+    ``xt`` is the already-padded input."""
+    b, hp, wp, c = xt.shape
+    _, ho, wo, o = dy.shape
+    taps = []
+    for u in range(kh):
+        for v in range(kw):
+            xs = lax.slice(
+                xt,
+                (0, u, v, 0),
+                (b, u + (ho - 1) * sh + 1, v + (wo - 1) * sw + 1, c),
+                (1, sh, sw, 1),
+            )
+            taps.append(
+                lax.dot_general(
+                    xs,
+                    dy,
+                    (((0, 1, 2), (0, 1, 2)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+    return jnp.stack(taps).reshape(kh, kw, c, o)
+
+
+def wgrad_folded(xt, dy, kh: int, kw: int):
+    """Stride-1 wgrad: per-tap dots when the canonical backward-filter
+    conv would materialize pathologically-padded copies
+    (:func:`_wgrad_taps_profitable`), else the fast conv form. Identical
+    math either way (mod f32 accumulation order — both contract in f32
+    on the MXU)."""
+    if _wgrad_taps_profitable(
+        xt.shape[0], xt.shape[-1],
+        float(np.prod(xt.shape)) * xt.dtype.itemsize,
+    ):
+        return wgrad_taps(xt, dy, kh, kw)
+    dw = lax.conv_general_dilated(
+        xt,
+        dy,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("CHWN", "IHWO", "NHWC"),
+    )  # out: [C, kh, kw, O]
+    return dw.transpose(1, 2, 0, 3)
+
+
+def conv_bwd_with_taps(conv_fn, taps_gate, x, w, dy, strides, padding):
+    """Shared backward for the strided/packed custom VJPs: dx always via
+    XLA's own transpose of ``conv_fn`` (its base-dilated form keeps
+    natural layouts — measured fine at every size); dw via per-tap
+    strided dots when ``taps_gate(x)`` says the backward-filter form
+    would materialize pathological copies (docs/PERF.md round 4), via
+    the same pullback otherwise. ``conv_fn(x, w)`` must be the forward
+    these gradients belong to."""
+    kh, kw = w.shape[0], w.shape[1]
+    _, pullback = jax.vjp(conv_fn, x, w)
+    if taps_gate(x):
+        dx, _ = pullback(dy)
+        (ph0, ph1), (pw0, pw1) = padding
+        xt = x
+        if ph0 or ph1 or pw0 or pw1:
+            xt = lax.pad(
+                x,
+                jnp.zeros((), x.dtype),
+                ((0, 0, 0), (ph0, ph1, 0), (pw0, pw1, 0), (0, 0, 0)),
+            )
+        dw = wgrad_taps(xt, dy, kh, kw, strides[0], strides[1])
+    else:
+        dx, dw = pullback(dy)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv2d_strided(x, w, strides, padding):
+    return _conv_plain(x, w, strides, padding)
+
+
+def _conv2d_strided_fwd(x, w, strides, padding):
+    return _conv_plain(x, w, strides, padding), (x, w)
+
+
+def _conv2d_strided_bwd(strides, padding, res, dy):
+    x, w = res
+    return conv_bwd_with_taps(
+        lambda xx, ww: _conv_plain(xx, ww, strides, padding),
+        lambda xx: _wgrad_taps_profitable(
+            xx.shape[0],
+            xx.shape[-1],
+            float(np.prod(xx.shape)) * xx.dtype.itemsize,
+        ),
+        x, w, dy, strides, padding,
+    )
+
+
+_conv2d_strided.defvjp(_conv2d_strided_fwd, _conv2d_strided_bwd)
 
 
 _conv2d_s1.defvjp(_conv2d_s1_fwd, _conv2d_s1_bwd)
@@ -294,8 +430,12 @@ def conv2d(x, w, strides=(1, 1), padding=((0, 0), (0, 0))):
     padding = tuple((int(p[0]), int(p[1])) for p in padding)
     impl = conv_impl()
     use_packed = impl == "packed" or (impl == "auto" and _on_tpu())
-    if not use_packed or strides != (1, 1):
+    if not use_packed:
         return _conv_plain(x, w, strides, padding)
+    if strides != (1, 1):
+        # Stock forward; custom backward that dodges the wgrad layout
+        # pathology at large sizes (see _conv2d_strided_bwd).
+        return _conv2d_strided(x, w, strides, padding)
     return _conv2d_s1(x, w, padding)
 
 

@@ -51,6 +51,7 @@ Parameter trees match the stock modules exactly (kernel [kh,kw,C,O], bias
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import flax.linen as nn
@@ -121,6 +122,56 @@ def packed_kernel(w, f_in: int, f_out: int, s: int, pw: int):
     return kp, s_p, pl_p
 
 
+def _taps_profitable_packed(x) -> bool:
+    """Use the per-tap wgrad for the packed core conv when the operand is
+    large AND the contraction batch is tiny (B <= 2): XLA's backward-
+    filter form space-to-depth-copies x AND dy (~4.5 GB of copies at
+    3072px bs=1 — docs/PERF.md round 4) because the contraction batch
+    underfills the feature dim; at larger batches the pathology is gone
+    and taps would just pay kh*kw' re-reads. Taps on the packed layout
+    are MXU-friendly (128-lane operands). Shares fastconv's env switches
+    (MPI4DL_TPU_WGRAD_TAPS[_MIN_MB])."""
+    import os
+
+    if os.environ.get("MPI4DL_TPU_WGRAD_TAPS", "auto") == "off":
+        return False
+    min_mb = float(os.environ.get("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "256"))
+    return (
+        x.shape[0] <= 2
+        and float(np.prod(x.shape)) * x.dtype.itemsize >= min_mb * 1e6
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _packed_core(x, kp, strides, padding):
+    """The packed conv's core ``conv_general_dilated`` with a backward
+    that dodges the wgrad space-to-depth copies at large sizes."""
+    return lax.conv_general_dilated(
+        x, kp, strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def _packed_core_fwd(x, kp, strides, padding):
+    return _packed_core(x, kp, strides, padding), (x, kp)
+
+
+def _packed_core_bwd(strides, padding, res, dy):
+    from mpi4dl_tpu.ops.fastconv import conv_bwd_with_taps
+
+    x, kp = res
+    return conv_bwd_with_taps(
+        lambda xx, kk: lax.conv_general_dilated(
+            xx, kk, strides, padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ),
+        _taps_profitable_packed,
+        x, kp, dy, strides, padding,
+    )
+
+
+_packed_core.defvjp(_packed_core_fwd, _packed_core_bwd)
+
+
 def conv2d_packed(
     xp,
     w,
@@ -174,13 +225,7 @@ def conv2d_packed(
             )
         h_loc = xp.shape[1]
         xe = halo_exchange(xp, ph0, hw_p)
-        y = lax.conv_general_dilated(
-            xe,
-            kp,
-            (sh, s_p),
-            ((0, 0), (0, 0)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        y = _packed_core(xe, kp, (sh, s_p), ((0, 0), (0, 0)))
         return y[:, : h_loc // sh, off : off + wout_p, :]
 
     w_logical = win_p * f_in
@@ -195,13 +240,7 @@ def conv2d_packed(
     # Right padding sized so the packed conv emits exactly wout_p columns
     # (the scattered kernel's tap range is asymmetric in general).
     pr_p = s_p * (wout_p - 1) + kp.shape[1] - pl_p - win_p
-    return lax.conv_general_dilated(
-        xp,
-        kp,
-        (sh, s_p),
-        ((ph0, ph1), (pl_p, pr_p)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+    return _packed_core(xp, kp, (sh, s_p), ((ph0, ph1), (pl_p, pr_p)))
 
 
 class PackedConv(nn.Module):

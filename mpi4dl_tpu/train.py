@@ -480,14 +480,14 @@ class Trainer:
                 # point of the no-checkpoint tier is to store residuals and
                 # replay nothing, which the plain scan body below (with
                 # ckpt == _no_ckpt) does.
-                hc = self._scan_nested(hc, stacked, apply_compact, unroll)
+                hc = self._scan_nested(hc, stacked, apply_compact)
             else:
                 hc, _ = lax.scan(body, hc, stacked, unroll=unroll)
             h = self._restore(hc, shapes)
         return h
 
     @staticmethod
-    def _scan_nested(hc, stacked, apply_compact, unroll):
+    def _scan_nested(hc, stacked, apply_compact):
         """Two-level (~sqrt-depth) checkpointing over one scan run — the
         "scan2" policy's heart. The run's n cells split into ~sqrt(n)-sized
         chunks; an outer lax.scan carries only CHUNK boundaries and each
@@ -506,10 +506,58 @@ class Trainer:
 
         def chunk(hc, ps):
             def body(hc, p):
-                return jax.checkpoint(apply_compact)(p, hc), None
+                # The barrier serializes consecutive cells' (rematted)
+                # backwards — its transpose is also a barrier — so only
+                # ONE cell's recompute temps are in flight. scan2 exists
+                # to fit, not to overlap: without this the @3072 compile
+                # holds ~2 cells' temps and misses HBM by ~400 MB
+                # (docs/PERF.md round 4). Inner unroll stays 1 for the
+                # same reason (MPI4DL_TPU_SCAN2_UNROLL overrides).
+                hc = jax.checkpoint(apply_compact)(p, hc)
+                return lax.optimization_barrier(hc), None
 
-            hc, _ = lax.scan(body, hc, ps, unroll=unroll)
+            inner_unroll = int(os.environ.get("MPI4DL_TPU_SCAN2_UNROLL", "1"))
+            hc, _ = lax.scan(body, hc, ps, unroll=inner_unroll)
             return hc
+
+        if os.environ.get("MPI4DL_TPU_SCAN2_OFFLOAD") == "1":
+            # Offload variant: ONE outer checkpoint over the whole run with
+            # the between-chunk boundaries tagged and a
+            # save_and_offload_only_these_names policy — the boundaries
+            # live in pinned host memory between the run's forward and its
+            # backward, occupying zero HBM, and each chunk's backward
+            # recomputes from its (fetched-back) boundary exactly like the
+            # on-device form. Measured 5.9 GB/s effective host<->device
+            # roundtrip on the tunneled runtime; this is the capability
+            # lever for >=4096px, where even the ~sqrt(n) on-device
+            # boundary set exceeds HBM (docs/PERF.md round 4). (A manual
+            # jax.device_put loop hits "moved to host ... returned from
+            # the entry computation" in the XLA offloader; the named-save
+            # policy is the supported path.)
+            from jax.ad_checkpoint import checkpoint_name
+
+            policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["scan2_boundary"],
+                offload_src="device",
+                offload_dst="pinned_host",
+            )
+            bounds = [0, rem] if rem else [0]
+            while bounds[-1] < n:
+                bounds.append(bounds[-1] + g)
+
+            def run_all(hc, stacked):
+                for lo, hi in zip(bounds, bounds[1:]):
+                    ps = jax.tree.map(lambda a: a[lo:hi], stacked)
+                    hc = chunk(hc, ps)
+                    if hi < n:  # the run output itself must stay on device
+                        hc = jax.tree.map(
+                            lambda a: checkpoint_name(a, "scan2_boundary"),
+                            hc,
+                        )
+                return hc
+
+            return jax.checkpoint(run_all, policy=policy)(hc, stacked)
 
         chunk_ck = jax.checkpoint(chunk)
         if rem:

@@ -103,6 +103,58 @@ def test_strided_conv_falls_back_and_matches(monkeypatch):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("strides", [(1, 1), (2, 2)])
+def test_taps_wgrad_grads_match(strides, monkeypatch):
+    """The big-size per-tap wgrad (and the strided custom VJP around it)
+    must equal stock XLA AD. The production gate needs >=256 MB operands;
+    MIN_MB=0 forces the taps branch on small shapes so the path is
+    exercised in CI (it is otherwise dead below 2048px)."""
+    monkeypatch.setenv("MPI4DL_TPU_CONV_IMPL", "packed")
+    monkeypatch.setenv("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "0")
+    x = _rand((1, 16, 16, 4))
+    w = _rand((3, 3, 4, 6), seed=1) * 0.3
+    padding = ((1, 1), (1, 1))
+
+    def loss_fast(x, w):
+        return jnp.sum(jnp.square(fastconv.conv2d(x, w, strides, padding)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.square(_ref_conv(x, w, strides, padding)))
+
+    gx, gw = jax.grad(loss_fast, (0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, (0, 1))(x, w)
+    np.testing.assert_allclose(gx, rx, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(gw, rw, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("k,s", [(3, 1), (3, 2), (1, 1)])
+def test_packed_core_taps_grads_match(k, s, monkeypatch):
+    """The packed-layout core conv's taps backward (bs=1 engages the
+    batch<=2 gate with MIN_MB=0) must equal stock AD of the plain conv
+    through the pack/unpack round trip."""
+    monkeypatch.setenv("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "0")
+    from mpi4dl_tpu.ops.packed import conv2d_packed, pack, pack_factor, unpack
+
+    c = o = 8  # equal c/o keeps f_in == f_out valid for every stride here
+    f_in, f_out = pack_factor(c, 32), pack_factor(o, 32 // s)
+    x = _rand((1, 16, 32, c))
+    w = _rand((k, k, c, o), seed=1) * 0.3
+    p = (k - 1) // 2
+    padding = ((p, p), (p, p))
+
+    def loss_packed(x, w):
+        y = conv2d_packed(pack(x, f_in), w, f_in, f_out, (s, s), padding)
+        return jnp.sum(jnp.square(unpack(y, f_out)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.square(_ref_conv(x, w, (s, s), padding)))
+
+    gx, gw = jax.grad(loss_packed, (0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, (0, 1))(x, w)
+    np.testing.assert_allclose(gx, rx, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(gw, rw, rtol=2e-4, atol=2e-4)
+
+
 def test_pack_factors_policy():
     # 1x1 never packs
     assert fastconv.pack_factors(1, 1, 16, 64) == (1, 1)

@@ -162,6 +162,15 @@ def test_scan2_nested_remat_matches_golden():
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
 
 
+def test_scan2_offload_matches_golden(monkeypatch):
+    """MPI4DL_TPU_SCAN2_OFFLOAD=1 moves scan2's outer chunk boundaries to
+    pinned host memory between forward and backward (the ≥4096px HBM
+    lever) — a pure storage-placement choice: numerics must equal the
+    on-device scan2 run and the golden step."""
+    monkeypatch.setenv("MPI4DL_TPU_SCAN2_OFFLOAD", "1")
+    test_scan2_nested_remat_matches_golden()
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "remat", ["cell", "sqrt", "scan", "scan2", "scan_save", "group_save"]
