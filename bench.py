@@ -513,12 +513,14 @@ def main():
             prior = extras.get("resnet110_2048px_bs1", {})
             if prior.get("value") is not None:
                 record(2048, prior["value"])
-            for size in (4096, 8192):
-                # ≥4096px: the nested-scan policy — under plain "scan" the
-                # stored carries alone (~16 GB at 4096) exceed HBM and the
-                # remote-compile helper dies at buffer assignment
-                # (docs/PERF.md round 4). BENCH_REMAT still overrides.
-                walk_remats = [remat_pref] if remat_pref else ["scan2"]
+            for size in (3072, 4096, 8192):
+                # ≥3072px: the whole-model logarithmic-recursion policy —
+                # under plain "scan" the stored carries alone exceed HBM
+                # and the remote-compile helper dies at buffer assignment;
+                # scanlog is also 4x faster than scan2 at 3072 (0.165 vs
+                # 0.040 img/s — more headroom avoids the near-capacity
+                # stalls, docs/PERF.md round 4). BENCH_REMAT overrides.
+                walk_remats = [remat_pref] if remat_pref else ["scanlog"]
                 # Key covers everything that shapes the compiled program —
                 # a different layout/dtype/policy A/B must not be skipped
                 # on another config's verdict.

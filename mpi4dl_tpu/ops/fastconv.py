@@ -21,11 +21,14 @@ the same products (mod f32 accumulation order). FLOPs inflate by
 16-64 channels). 1x1 convs never profit: inflation is exactly ``fh*fw``,
 cancelling the N gain — they stay on the stock path.
 
-The custom VJP (stride-1 convs only; strided convs take the stock XLA path
-end to end) packs the data gradient too — itself a small-N stride-1 conv of
-``dy`` with the flipped/io-swapped kernel — and computes the weight
-gradient with the classic transposed-wgrad conv (x as "CHWN", dy as the
-kernel), the same canonical form XLA's own AD emits.
+Custom VJPs cover BOTH stride-1 and strided convs. The stride-1 backward
+packs the data gradient too — itself a small-N stride-1 conv of ``dy``
+with the flipped/io-swapped kernel; the weight gradient uses the classic
+transposed-wgrad conv (x as "CHWN", dy as the kernel) at ordinary sizes
+and switches to per-tap ``dot_general``s (``wgrad_taps``) in the big-
+size/small-batch regime where the conv form materializes pathologically-
+padded operand copies (docs/PERF.md round 4). Strided convs keep XLA's
+forward and dx but route their wgrad through the same taps gate.
 
 Used by :class:`mpi4dl_tpu.ops.layers.Conv2d` via :class:`FastConv`;
 selection is automatic (TPU + profitable shapes) and can be forced or
@@ -237,8 +240,12 @@ def _conv2d_s1_bwd(padding, res, dy):
     kh, kw, _, _ = w.shape
     (ph0, ph1), (pw0, pw1) = padding
 
-    big = _wgrad_taps_profitable(
-        x.shape[0], x.shape[-1], float(np.prod(x.shape)) * x.dtype.itemsize
+    big = (
+        not (kh == 1 and kw == 1)  # the 1x1 dx IS the layout-safe 4-D dot
+        and _wgrad_taps_profitable(
+            x.shape[0], x.shape[-1],
+            float(np.prod(x.shape)) * x.dtype.itemsize,
+        )
     )
     # dx: full correlation with the flipped, io-swapped kernel — a stride-1
     # small-N conv itself, so it goes through the packed dispatch too. In

@@ -136,9 +136,17 @@ def _taps_profitable_packed(x) -> bool:
     if os.environ.get("MPI4DL_TPU_WGRAD_TAPS", "auto") == "off":
         return False
     min_mb = float(os.environ.get("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "256"))
+    b, c = x.shape[0], x.shape[-1]
+    # Gate on the PADDED copy estimate, not raw bytes: the backward-filter
+    # form pads the operand ~256/(B*C)-fold (an un-packed 3-channel stem
+    # input at 4096px is 96 MB raw but an 8 GB padded copy — docs/PERF.md
+    # round 4); fully-packed 128-lane operands still pay ~2x plus the
+    # space-to-depth copies.
+    expansion = 256.0 / (b * min(c, 128))
     return (
-        x.shape[0] <= 2
-        and float(np.prod(x.shape)) * x.dtype.itemsize >= min_mb * 1e6
+        b <= 2
+        and float(np.prod(x.shape)) * x.dtype.itemsize * max(expansion, 2.0)
+        >= min_mb * 1e6
     )
 
 

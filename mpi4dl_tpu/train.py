@@ -155,12 +155,13 @@ class Trainer:
         if num_spatial_cells > 0 and plain_cells is None:
             raise ValueError("spatial models need plain_cells for initialization")
         if remat not in (
-            False, True, "cell", "sqrt", "scan", "scan2", "scan_save",
-            "cell_save", "group_save",
+            False, True, "cell", "sqrt", "scan", "scan2", "scanlog",
+            "scan_save", "cell_save", "group_save",
         ):
             raise ValueError(
                 "remat must be False, True, 'cell', 'sqrt', 'scan', 'scan2', "
-                f"'scan_save', 'cell_save' or 'group_save', got {remat!r}"
+                f"'scanlog', 'scan_save', 'cell_save' or 'group_save', "
+                f"got {remat!r}"
             )
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -486,6 +487,48 @@ class Trainer:
             h = self._restore(hc, shapes)
         return h
 
+    def _run_cell(self, i, p, h):
+        """Apply cell ``i`` (inserting the SP→LP tile merge before cell
+        ``n_spatial``) — the one definition of the merge point, shared by
+        every remat policy."""
+        if i == self.n_spatial and self.n_spatial > 0:
+            h = jax.tree.map(gather_tiles, h)
+        return self.cells[i].apply(p, h)
+
+    def _apply_cells_scanlog(self, params, x):
+        """remat="scanlog": logarithmic recursive checkpointing over the
+        WHOLE cell sequence — split in half, checkpoint the left half,
+        recurse into both; leaves are per-cell checkpoints. Live saved
+        boundaries are one per recursion level (~log2 N of MIXED sizes:
+        the path into the expensive early-stage cells is mostly small
+        early boundaries, and the later stages' saves are freed before
+        the early stages' backward runs), versus scan2's ~2*sqrt(n)
+        same-size set per run PLUS every singleton cell's pinned input.
+        Measured @3072px (docs/PERF.md round 4): recursive structures
+        pack with ~7% buffer-assignment fragmentation where scan runs
+        fragment 36-46%. Cost: each cell's forward recomputes ~depth
+        times (~5-6x at N=38). This is the deepest-memory policy — it is
+        what lands 3072px on one 16 GB chip (0.165 img/s; 4096px still
+        exceeds HBM by ~8 GB of genuinely-live boundaries, docs/PERF.md
+        round 4); barriers keep one rematted backward in flight."""
+
+        def rec(i, j, ps, h):
+            if j - i == 1:
+                h = jax.checkpoint(functools.partial(self._run_cell, i))(
+                    ps[0], h
+                )
+                return lax.optimization_barrier(h)
+            mid = (i + j) // 2
+
+            def left(ps_left, h):
+                return rec(i, mid, ps_left, h)
+
+            h = jax.checkpoint(left)(ps[: mid - i], h)
+            h = lax.optimization_barrier(h)
+            return rec(mid, j, ps[mid - i :], h)
+
+        return rec(0, len(self.cells), list(params), x)
+
     @staticmethod
     def _scan_nested(hc, stacked, apply_compact):
         """Two-level (~sqrt-depth) checkpointing over one scan run — the
@@ -521,43 +564,47 @@ class Trainer:
             return hc
 
         if os.environ.get("MPI4DL_TPU_SCAN2_OFFLOAD") == "1":
-            # Offload variant: ONE outer checkpoint over the whole run with
-            # the between-chunk boundaries tagged and a
-            # save_and_offload_only_these_names policy — the boundaries
-            # live in pinned host memory between the run's forward and its
-            # backward, occupying zero HBM, and each chunk's backward
-            # recomputes from its (fetched-back) boundary exactly like the
-            # on-device form. Measured 5.9 GB/s effective host<->device
-            # roundtrip on the tunneled runtime; this is the capability
-            # lever for >=4096px, where even the ~sqrt(n) on-device
-            # boundary set exceeds HBM (docs/PERF.md round 4). (A manual
-            # jax.device_put loop hits "moved to host ... returned from
-            # the entry computation" in the XLA offloader; the named-save
-            # policy is the supported path.)
-            from jax.ad_checkpoint import checkpoint_name
+            # Offload variant: the outer level is a Python loop whose
+            # INTERIOR chunk boundaries are pinned-host tensors — each
+            # chunk's jax.checkpoint then saves the host copy, so between
+            # that chunk's forward and backward the boundary occupies zero
+            # HBM (measured 5.9 GB/s effective roundtrip). The first and
+            # last chunks keep device inputs: host values adjacent to the
+            # program's entry/exit trip the XLA offloader ("moved to host
+            # ... returned from the entry computation"), and the
+            # optimization barriers around each transfer stop placement
+            # propagation into neighboring fusions; jax.memory.Space
+            # transfers preserve the traced sharding, so the path is
+            # mesh-shape-agnostic. (A single outer
+            # checkpoint with a save_and_offload policy was measured
+            # WORSE — one big recompute region overlaps chunks'
+            # backwards, docs/PERF.md round 4.)
+            def chunk_off(hc_host, ps):
+                hc = jax.tree.map(
+                    lambda a: jax.device_put(a, jax.memory.Space.Device),
+                    hc_host,
+                )
+                hc = lax.optimization_barrier(hc)
+                return chunk(hc, ps)
 
-            policy = jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
-                names_which_can_be_offloaded=["scan2_boundary"],
-                offload_src="device",
-                offload_dst="pinned_host",
-            )
+            chunk_off_ck = jax.checkpoint(chunk_off)
+            chunk_ck_plain = jax.checkpoint(chunk)
             bounds = [0, rem] if rem else [0]
             while bounds[-1] < n:
                 bounds.append(bounds[-1] + g)
-
-            def run_all(hc, stacked):
-                for lo, hi in zip(bounds, bounds[1:]):
-                    ps = jax.tree.map(lambda a: a[lo:hi], stacked)
-                    hc = chunk(hc, ps)
-                    if hi < n:  # the run output itself must stay on device
-                        hc = jax.tree.map(
-                            lambda a: checkpoint_name(a, "scan2_boundary"),
-                            hc,
-                        )
-                return hc
-
-            return jax.checkpoint(run_all, policy=policy)(hc, stacked)
+            for i, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+                ps = jax.tree.map(lambda a: a[lo:hi], stacked)
+                interior = 0 < i < len(bounds) - 2
+                if interior:
+                    hc = lax.optimization_barrier(hc)
+                    hc_host = jax.tree.map(
+                        lambda a: jax.device_put(a, jax.memory.Space.Host),
+                        hc,
+                    )
+                    hc = chunk_off_ck(hc_host, ps)
+                else:
+                    hc = chunk_ck_plain(hc, ps)
+            return hc
 
         chunk_ck = jax.checkpoint(chunk)
         if rem:
@@ -572,12 +619,10 @@ class Trainer:
     def _apply_cells_remat(self, params, x):
         """Run all cells under the configured remat policy (inserting the
         SP→LP tile merge before cell ``n_spatial``)."""
+        run_cell = self._run_cell
 
-        def run_cell(i, p, h):
-            if i == self.n_spatial and self.n_spatial > 0:
-                h = jax.tree.map(gather_tiles, h)
-            return self.cells[i].apply(p, h)
-
+        if self.remat == "scanlog":
+            return self._apply_cells_scanlog(params, x)
         if self.remat in ("scan", "scan2", "scan_save", "cell_save"):
             return self._apply_cells_scan(params, x)
         if self.remat in (True, "cell"):

@@ -84,11 +84,12 @@ def main():
     peak = None
     size = args.start
     while size <= args.max:
-        if size >= 4096:
-            # Nested-scan is the only policy whose carries fit HBM here
-            # (see Trainer._scan_nested); larger sizes would waste a
-            # multi-minute doomed compile per leaner policy otherwise.
-            remats = ["scan2"]
+        if size >= 3072:
+            # Whole-model logarithmic recursion is the only policy whose
+            # live boundary set fits HBM here (Trainer._apply_cells_scanlog);
+            # larger sizes would waste a multi-minute doomed compile per
+            # leaner policy otherwise.
+            remats = ["scanlog"]
         elif args.model == "amoebanet":
             remats = ["scan_save", "scan"]
         else:

@@ -135,15 +135,17 @@ def test_pure_dp_no_spatial():
     _assert_tree_close(state.params, golden_state.params, rtol=1e-4, atol=1e-6)
 
 
-def test_scan2_nested_remat_matches_golden():
-    """The "scan2" policy (two-level checkpointing inside scan runs — the
-    ≥4096px memory policy) is a pure scheduling choice: depth-44 gives
-    7-cell runs, exercising BOTH the chunked outer scan (g=3, m=2) and the
-    remainder head-chunk path (rem=1); depth-20's 3-cell runs (below the
-    nesting threshold) are covered by the "scan" parametrization below."""
+def test_scan2_nested_remat_matches_golden(remat="scan2"):
+    """The "scan2" policy (two-level checkpointing inside scan runs) and
+    the "scanlog" policy (whole-model logarithmic recursion — the deepest-
+    memory tier, ≥3072px) are pure scheduling choices: depth-44 gives
+    7-cell runs, exercising BOTH scan2's chunked outer scan (g=3, m=2) and
+    its remainder head-chunk path (rem=1), and odd left/right splits in
+    scanlog's recursion; depth-20's 3-cell runs (below scan2's nesting
+    threshold) are covered by the "scan" parametrization below."""
     cells = get_resnet_v1(depth=44)
     cfg = ParallelConfig(batch_size=2, split_size=1, spatial_size=0, image_size=32)
-    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat="scan2")
+    trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat=remat)
     state = trainer.init(jax.random.PRNGKey(3), (2, 32, 32, 3))
     _, golden_step = single_device_step(cells)
     gp = jax.tree.map(jnp.copy, state.params)
@@ -162,6 +164,10 @@ def test_scan2_nested_remat_matches_golden():
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
 
 
+def test_scanlog_matches_golden():
+    test_scan2_nested_remat_matches_golden(remat="scanlog")
+
+
 def test_scan2_offload_matches_golden(monkeypatch):
     """MPI4DL_TPU_SCAN2_OFFLOAD=1 moves scan2's outer chunk boundaries to
     pinned host memory between forward and backward (the ≥4096px HBM
@@ -173,7 +179,8 @@ def test_scan2_offload_matches_golden(monkeypatch):
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "remat", ["cell", "sqrt", "scan", "scan2", "scan_save", "group_save"]
+    "remat",
+    ["cell", "sqrt", "scan", "scan2", "scanlog", "scan_save", "group_save"],
 )
 def test_remat_policies_match_golden(remat):
     """Every remat policy is a pure scheduling choice: losses, metrics, and
