@@ -297,6 +297,38 @@ def _conv2d_s1_bwd(padding, res, dy):
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
+# Padded-copy threshold (MB) above which the per-tap wgrad engages — ONE
+# value shared by the fastconv and packed gates. Default 3072 MB: padded
+# copies up to a few GB are cheaper than the taps' kh*kw operand re-reads
+# (the @1024 stem conv taking taps at a 537 MB copy measured a 13%
+# END-TO-END loss, docs/PERF.md round 4); only the >=3072px regime (where
+# the copies OOM) wants the aggressive setting, which Trainer.train_step
+# arms via the context manager below. MPI4DL_TPU_WGRAD_TAPS_MIN_MB
+# overrides BOTH gates unconditionally.
+_TAPS_MIN_MB = [3072.0]
+
+
+def taps_min_mb() -> float:
+    env = os.environ.get("MPI4DL_TPU_WGRAD_TAPS_MIN_MB")
+    return float(env) if env else _TAPS_MIN_MB[0]
+
+
+class wgrad_taps_threshold:
+    """Context manager scoping the taps gate threshold (MB) for the
+    enclosed trace — how :class:`mpi4dl_tpu.train.Trainer` arms the
+    aggressive big-image setting without mutating process state."""
+
+    def __init__(self, mb: float):
+        self._mb = float(mb)
+
+    def __enter__(self):
+        self._prev = _TAPS_MIN_MB[0]
+        _TAPS_MIN_MB[0] = self._mb
+
+    def __exit__(self, *exc):
+        _TAPS_MIN_MB[0] = self._prev
+
+
 def _wgrad_taps_profitable(b: int, c: int, x_bytes: float) -> bool:
     """True when the canonical backward-filter conv would materialize
     pathologically-padded operand copies and the per-tap dot form should
@@ -310,15 +342,13 @@ def _wgrad_taps_profitable(b: int, c: int, x_bytes: float) -> bool:
     >2048px ResNet train step exceed HBM at compile (docs/PERF.md round
     4; row-folding the batch was tried first and just moved the padding
     into 5x-padded chunk copies). Gate: expansion >= 4 AND the padded
-    copy would exceed ``MPI4DL_TPU_WGRAD_TAPS_MIN_MB`` (default 256 —
-    small images pay kh*kw re-reads for nothing).
+    copy would exceed :func:`taps_min_mb`.
     ``MPI4DL_TPU_WGRAD_TAPS`` = auto (default) | off.
     """
     if os.environ.get("MPI4DL_TPU_WGRAD_TAPS", "auto") == "off":
         return False
-    min_mb = float(os.environ.get("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "256"))
     expansion = 256.0 / (b * c)
-    return expansion >= 4.0 and x_bytes * expansion >= min_mb * 1e6
+    return expansion >= 4.0 and x_bytes * expansion >= taps_min_mb() * 1e6
 
 
 def wgrad_taps(xt, dy, kh: int, kw: int, sh: int = 1, sw: int = 1):
@@ -440,9 +470,15 @@ def conv2d(x, w, strides=(1, 1), padding=((0, 0), (0, 0))):
     if not use_packed:
         return _conv_plain(x, w, strides, padding)
     if strides != (1, 1):
-        # Stock forward; custom backward that dodges the wgrad layout
-        # pathology at large sizes (see _conv2d_strided_bwd).
-        return _conv2d_strided(x, w, strides, padding)
+        # Custom backward only when the big-size wgrad pathology gate is
+        # armed for this shape (see _conv2d_strided_bwd) — the custom_vjp
+        # wrapper itself costs fusion opportunities at small sizes.
+        if _wgrad_taps_profitable(
+            x.shape[0], x.shape[-1],
+            float(np.prod(x.shape)) * x.dtype.itemsize,
+        ):
+            return _conv2d_strided(x, w, strides, padding)
+        return _conv_plain(x, w, strides, padding)
     return _conv2d_s1(x, w, padding)
 
 

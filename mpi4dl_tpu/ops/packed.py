@@ -129,13 +129,17 @@ def _taps_profitable_packed(x) -> bool:
     3072px bs=1 — docs/PERF.md round 4) because the contraction batch
     underfills the feature dim; at larger batches the pathology is gone
     and taps would just pay kh*kw' re-reads. Taps on the packed layout
-    are MXU-friendly (128-lane operands). Shares fastconv's env switches
-    (MPI4DL_TPU_WGRAD_TAPS[_MIN_MB])."""
+    are MXU-friendly (128-lane operands). Shares fastconv's off switch
+    (MPI4DL_TPU_WGRAD_TAPS) and its single threshold (taps_min_mb: the
+    3072 MB default, the Trainer's big-image context, or the env
+    override — one value for both gates)."""
     import os
+
+    from mpi4dl_tpu.ops.fastconv import taps_min_mb
 
     if os.environ.get("MPI4DL_TPU_WGRAD_TAPS", "auto") == "off":
         return False
-    min_mb = float(os.environ.get("MPI4DL_TPU_WGRAD_TAPS_MIN_MB", "256"))
+    min_mb = taps_min_mb()
     b, c = x.shape[0], x.shape[-1]
     # Gate on the PADDED copy estimate, not raw bytes: the backward-filter
     # form pads the operand ~256/(B*C)-fold (an un-packed 3-channel stem
@@ -178,6 +182,19 @@ def _packed_core_bwd(strides, padding, res, dy):
 
 
 _packed_core.defvjp(_packed_core_fwd, _packed_core_bwd)
+
+
+def _core(x, kp, strides, padding):
+    """Dispatch: the custom-VJP core only when the taps gate is armed for
+    this shape — wrapping every conv in a custom_vjp was measured ~10%
+    slower end-to-end at @1024 (the wrapper pins residuals and walls off
+    fwd/bwd fusion XLA otherwise does); stock AD handles the small-size
+    regime exactly as before."""
+    if _taps_profitable_packed(x):
+        return _packed_core(x, kp, strides, padding)
+    return lax.conv_general_dilated(
+        x, kp, strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
 
 
 def conv2d_packed(
@@ -233,7 +250,7 @@ def conv2d_packed(
             )
         h_loc = xp.shape[1]
         xe = halo_exchange(xp, ph0, hw_p)
-        y = _packed_core(xe, kp, (sh, s_p), ((0, 0), (0, 0)))
+        y = _core(xe, kp, (sh, s_p), ((0, 0), (0, 0)))
         return y[:, : h_loc // sh, off : off + wout_p, :]
 
     w_logical = win_p * f_in
@@ -248,7 +265,7 @@ def conv2d_packed(
     # Right padding sized so the packed conv emits exactly wout_p columns
     # (the scattered kernel's tap range is asymmetric in general).
     pr_p = s_p * (wout_p - 1) + kp.shape[1] - pl_p - win_p
-    return _packed_core(xp, kp, (sh, s_p), ((ph0, ph1), (pl_p, pr_p)))
+    return _core(xp, kp, (sh, s_p), ((ph0, ph1), (pl_p, pr_p)))
 
 
 class PackedConv(nn.Module):

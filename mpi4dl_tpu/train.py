@@ -63,6 +63,28 @@ def _no_ckpt(fn):
     return fn
 
 
+def xla_compiler_options() -> "dict[str, str] | None":
+    """Per-compile XLA option overrides from ``MPI4DL_TPU_XLA_OPTS``
+    ("k=v,k2=v2"), passed via ``jax.jit(compiler_options=...)``. This is
+    the only way to reach TPU-backend flags on the tunneled runtime: the
+    CLIENT process has no libtpu, so TPU-only names in ``XLA_FLAGS`` are
+    fatally rejected by its parser, while proto-backed per-compile
+    options are forwarded to the remote compile helper (its own log says
+    so). None when unset, so stock configs share the jit cache."""
+    spec = os.environ.get("MPI4DL_TPU_XLA_OPTS", "").strip()
+    if not spec:
+        return None
+    opts = {}
+    for item in spec.split(","):
+        k, _, v = item.partition("=")
+        if not k or not v:
+            raise ValueError(
+                f"MPI4DL_TPU_XLA_OPTS items must be k=v, got {item!r}"
+            )
+        opts[k.strip()] = v.strip()
+    return opts
+
+
 def scan_unroll() -> int:
     """Resolved lax.scan unroll factor for scanned cell runs (default 3,
     ``MPI4DL_TPU_SCAN_UNROLL`` overrides — measurements in the
@@ -165,6 +187,7 @@ class Trainer:
             )
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+
         self.grad_accum = grad_accum
         self.remat = remat
         self.cells = list(cells)
@@ -181,7 +204,11 @@ class Trainer:
             # via the psum-of-contributions normalization).
             self.x_spec = P(AXIS_DATA, None, None, None)
         self.y_spec = P(AXIS_DATA)
-        self._jit_step = jax.jit(self._train_step, donate_argnums=0)
+        self._jit_step = jax.jit(
+            self._train_step,
+            donate_argnums=0,
+            compiler_options=xla_compiler_options(),
+        )
 
     # -- initialization ------------------------------------------------------
     def init(self, rng, sample_shape: Sequence[int], dtype=jnp.float32) -> TrainState:
@@ -786,6 +813,17 @@ class Trainer:
         return put_global(self.mesh, (self.x_spec, self.y_spec), x, y)
 
     def train_step(self, state: TrainState, x, y):
+        from mpi4dl_tpu.ops.fastconv import wgrad_taps_threshold
+
+        if self.config.image_size >= 3072:
+            # Arm the aggressive per-tap wgrad gate for this trace: at
+            # these sizes the backward-filter conv's padded operand
+            # copies are what OOMs the step (docs/PERF.md round 4). A
+            # trace-time context, not process state — other Trainers in
+            # the process keep the 3072 MB default; the env override
+            # still wins inside taps_min_mb.
+            with wgrad_taps_threshold(256):
+                return call_with_halo_hint(self._jit_step, state, x, y)
         return call_with_halo_hint(self._jit_step, state, x, y)
 
 
