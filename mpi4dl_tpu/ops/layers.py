@@ -443,7 +443,16 @@ def max_pool_s1_valid(x, kh: int, kw: int):
     vs first-window-element); every model path (plain, spatial, D2) uses
     THIS implementation for stride-1 pools, so golden comparisons are
     impl-consistent, like the reference's CUDA pooling is with itself.
+
+    On TPU, shapes the one-pass Pallas backward admits dispatch to
+    :mod:`mpi4dl_tpu.ops.pool_pallas` instead (identical forward values;
+    first-max-wins backward — the ``select_and_scatter`` tie rule); the
+    tree stays the CPU/test path and the fallback.
     """
+    from mpi4dl_tpu.ops import pool_pallas
+
+    if pool_pallas.dispatchable(x, kh, kw, 1, 1, 0, 0):
+        return pool_pallas.max_pool(x, kh, kw, 1, 1, 0, 0)
     h, w = x.shape[1], x.shape[2]
     # Separable: max over rows, then cols (associativity makes the forward
     # identical to the 2-D window) — kh+kw maximum ops instead of kh*kw, and
@@ -513,7 +522,21 @@ class Pool(nn.Module):
             pad = ((ph, ph), (pw, pw))
 
         if self.kind == "max":
-            if (sh, sw) == (1, 1):
+            from mpi4dl_tpu.ops import pool_pallas
+
+            if (
+                (sh, sw) != (1, 1)
+                and pool_bwd_impl() != "decomposed"  # explicit A/B lever wins
+                and pool_pallas.dispatchable(
+                    x, kh, kw, sh, sw, pad[0][0], pad[1][0]
+                )
+            ):
+                # Strided pools (the REDUCTION cells' k3 s2 / k2 s2):
+                # identical forward to reduce_window; the backward is the
+                # one-pass Pallas kernel instead of select_and_scatter
+                # (6.9% of the AmoebaNet@1024 step — docs/PERF.md round 4).
+                y = pool_pallas.max_pool(x, kh, kw, sh, sw, pad[0][0], pad[1][0])
+            elif (sh, sw) == (1, 1):
                 # Stride-1: shifted-maximum decomposition (cheap backward;
                 # see max_pool_s1_valid). -inf edge pad == torch MaxPool2d.
                 # Strided pools deliberately stay on reduce_window: slicing

@@ -1,0 +1,451 @@
+"""Pallas TPU kernel: one-pass max-pool backward (first-max-wins).
+
+Why: the round-4 AmoebaNet@1024 profile puts ~16% of the train step in
+max-pool backwards — ``select_and_scatter`` for the reduction cells'
+stride-2 pools (6.9%) plus the stride-1 shifted-maximum tree's
+select/accumulate chains (most of the 10.3% ``mul`` + 4.0% ``max``
+classes; the genotype runs a 3x3 s1 max pool in every cell,
+``models/amoebanet.py``). Both existing backwards are multi-pass at HBM:
+``select_and_scatter`` walks windows sequentially, and the kh+kw tree
+backward re-materializes the select chain pass by pass. The reference
+leaves all of this to cuDNN (``MaxPool2d`` inside ``Pool``,
+``spatial.py:1416-1509``); on TPU the op is ours to schedule.
+
+This kernel computes dx in ONE streaming pass: per (batch, window-row
+chunk, channel chunk) grid step it loads the padded input, the pooled
+output and the cotangent once into VMEM, recomputes each window's winner
+in-register (kh*kw compare/claim steps, row-major first-max-wins —
+the same tie semantics as ``select_and_scatter``'s GE select; the
+row-major first-claim decomposition was proved bit-equal to it on
+tie-heavy data in ``tests/test_spatial_layers.py``), and accumulates the
+scattered contributions in VMEM. HBM traffic is x + y + dy read once,
+dx written once — the roofline for this op.
+
+Layout notes (mirrors ``wgrad_pallas``): blocks keep NHWC with C on
+lanes and W on sublanes; all in-kernel shifts are static ``lax.slice`` /
+``jnp.pad`` on values; window-chunk overlap rows arrive through a second
+aligned BlockSpec ("tail"), and the per-chunk rows that spill past the
+chunk (a window's last kh-sh rows) leave through a second output the
+wrapper folds back in — Pallas index maps cannot express overlapping
+blocks in either direction.
+
+Stride-2 support uses a parity ("polyphase") decomposition: dx rows/cols
+of each residue class (r mod sh, c mod sw) are produced as separate
+dense sub-arrays inside the kernel (taps grouped by parity; per class
+the scatter offsets are plain static shifts), and the wrapper
+interleaves the sh*sw classes back with one strided-set each — no
+interior-padded full-resolution scatter terms (the failure mode that
+made the XLA-level decomposition 32% SLOWER end-to-end,
+``pool_bwd_impl``/docs/PERF.md round 4).
+
+Dispatch: ``usable()`` = shape gate + cached on-device compile probe
+(Mosaic failures only surface on real hardware); fallbacks are the
+existing tree / reduce_window paths, so the step cannot be broken by a
+kernel regression. ``MPI4DL_TPU_POOL_PALLAS=off`` disables for A/B.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG = float("-inf")
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def pool_pallas_mode() -> str:
+    mode = os.environ.get("MPI4DL_TPU_POOL_PALLAS", "auto")
+    if mode not in ("auto", "off"):
+        raise ValueError(
+            f"MPI4DL_TPU_POOL_PALLAS must be auto|off, got {mode!r}"
+        )
+    return mode
+
+
+def _class_geometry(kh, kw, sh, sw):
+    """Per parity class (cr, cc): max row/col shift (D, E). Class (cr, cc)
+    holds dx rows r ≡ cr (mod sh) / cols ≡ cc (mod sw); tap (u, v) with
+    u ≡ cr, v ≡ cc scatters window (a, b) to class position
+    (a + (u-cr)//sh, b + (v-cc)//sw) — a plain static shift."""
+    geo = {}
+    for cr in range(sh):
+        for cc in range(sw):
+            ups = [u for u in range(kh) if u % sh == cr]
+            vps = [v for v in range(kw) if v % sw == cc]
+            if not ups or not vps:
+                continue
+            geo[(cr, cc)] = (
+                max((u - cr) // sh for u in ups),
+                max((v - cc) // sw for v in vps),
+            )
+    return geo
+
+
+def _pool_bwd_kernel(*refs, kh, kw, sh, sw, to, wo):
+    """One (batch, window-row chunk, channel chunk) grid step.
+
+    refs: per parity plane (in geometry order) a main x ref
+    [1, to, Wp_p, Cc] and — when the plane has row spill D > 0 — a tail
+    ref [1, D, Wp_p, Cc]; then the dy ref [1, to, Wo, Cc]; then the
+    outputs: per class a main ref [1, to, Wc, Cc] and (D > 0) a tail ref
+    [1, 1, D, Wc, Cc]. Input planes and output classes share the same
+    parity geometry: tap (u, v) lives on plane (u%sh, v%sw) at offset
+    (u//sh, v//sw), and scatters window (a, b) to dx class (u%sh, v%sw)
+    at the same offset — dx is in input coordinates.
+    """
+    geo = _class_geometry(kh, kw, sh, sw)
+    ri = 0
+    planes = {}
+    for key, (dmax, emax) in geo.items():
+        xpl = refs[ri][0]
+        ri += 1
+        if dmax:
+            xpl = jnp.concatenate([xpl, refs[ri][0]], axis=0)
+            ri += 1
+        planes[key] = xpl
+    dy = refs[ri][0]
+    outs = refs[ri + 1 :]
+    c = dy.shape[-1]
+    zero = jnp.zeros((), dy.dtype)
+
+    def tap(u, v):
+        """This tap's value per window: a contiguous plane slice."""
+        xpl = planes[(u % sh, v % sw)]
+        d, e = u // sh, v // sw
+        return lax.slice(xpl, (d, e, 0), (d + to, e + wo, c))
+
+    # Online argmax in window order: strict > keeps the FIRST maximum —
+    # select_and_scatter's tie rule. Compares run in f32 (Mosaic on this
+    # target rejects bf16 cmpf, 16-bit ordered cmpi, AND 16-bit cmpi-eq
+    # whose mask feeds a bf16 select — all probed; docs/PERF.md round 4
+    # has the full support matrix). The f32 widening unpacks the
+    # (8,128,2) VMEM tiling and is the kernel's main device cost;
+    # every leaner formulation tried (single whole-block convert,
+    # 16-bit bit-equality claims, u16 radix keys, pltpu.roll W-shifts,
+    # grouped pads, XLA-level chunked calls) either hits an unsupported
+    # Mosaic op or trips the runtime's VMEM stack allocation of
+    # custom-call operands/results — this exact structure is the one
+    # that compiles. Measured ledger in docs/PERF.md round 4.
+    best = tap(0, 0).astype(jnp.float32)
+    idx = jnp.zeros(best.shape, jnp.int32)
+    ti = 0
+    for u in range(kh):
+        for v in range(kw):
+            if ti:
+                x_uv = tap(u, v).astype(jnp.float32)
+                better = x_uv > best
+                best = jnp.where(better, x_uv, best)
+                idx = jnp.where(better, ti, idx)
+            ti += 1
+
+    # Per-class accumulation: static shifted adds inside VMEM.
+    oi = 0
+    for (cr, cc), (dmax, emax) in geo.items():
+        acc = None
+        for u in range(cr, kh, sh):
+            d = (u - cr) // sh
+            for v in range(cc, kw, sw):
+                e = (v - cc) // sw
+                contrib = jnp.where(idx == (u * kw + v), dy, zero)
+                term = jnp.pad(
+                    contrib,
+                    ((d, dmax - d), (e, emax - e), (0, 0)),
+                )
+                acc = term if acc is None else acc + term
+        outs[oi][0] = acc[:to]
+        oi += 1
+        if dmax:
+            outs[oi][0] = acc[to:]
+            oi += 1
+
+
+def _chunk_c(c: int) -> int:
+    """Channel chunk: whole when narrow or not 128-divisible (Mosaic
+    requires the lane-dim block size to be a multiple of 128 or the
+    whole array dim — e.g. 416 and 832 stay whole and _plan's VMEM
+    budget decides viability), else the smallest 128-multiple divisor;
+    C on lanes means chunks are independent."""
+    if c <= 256 or c % 128:
+        return c
+    for mult in range(128, c, 128):
+        if c % mult == 0:
+            return mult
+    return c
+
+
+def _plan(c, ho, wo, kh, kw, sh, sw, itemsize):
+    """Pick (row chunk ``to``, channel chunk); None when nothing fits."""
+    cc = _chunk_c(c)
+    geo = _class_geometry(kh, kw, sh, sw)
+    for to in (32, 16, 8, 4, 2, 1):
+        if ho % to:
+            continue
+        # Each plane's tail BlockSpec needs element row (i+1)*to to be a
+        # multiple of its own block height D.
+        if any(d > 0 and to % d for d, _ in geo.values()):
+            continue
+        plane_bytes = sum(
+            (to + d) * (wo + e) * cc * itemsize for d, e in geo.values()
+        )
+        dy_bytes = to * wo * cc * itemsize
+        argmax_bytes = to * wo * cc * 8  # f32 best + i32 idx
+        acc_bytes = max(
+            (to + d) * (wo + e) * cc * itemsize * 2  # acc + pad temp
+            for d, e in geo.values()
+        )
+        if (
+            plane_bytes + dy_bytes + argmax_bytes + acc_bytes
+            < _VMEM_BUDGET
+        ):
+            return to, cc
+    return None
+
+
+def _out_geom(hp, wp, kh, kw, sh, sw):
+    """(ho, wo, covered hp, covered wp) under reduce_window "valid"."""
+    ho = (hp - kh) // sh + 1
+    wo = (wp - kw) // sw + 1
+    return ho, wo, (ho - 1) * sh + kh, (wo - 1) * sw + kw
+
+
+def supported(x_shape, kh, kw, sh, sw, ph, pw, itemsize=2) -> bool:
+    b, h, w, c = x_shape
+    if kh <= sh and kw <= sw:
+        return False  # non-overlapping: XLA's backward is already a reshape
+    hp, wp = h + 2 * ph, w + 2 * pw
+    if hp < kh or wp < kw:
+        return False
+    ho, wo, _, _ = _out_geom(hp, wp, kh, kw, sh, sw)
+    return _plan(c, ho, wo, kh, kw, sh, sw, itemsize) is not None
+
+
+@functools.lru_cache(maxsize=None)
+def _compiles(x_shape, dtype, kh, kw, sh, sw, ph, pw) -> bool:
+    """Cached on-device compile probe (pattern: wgrad_pallas._compiles)."""
+    import warnings
+
+    try:
+        b, h, w, c = x_shape
+        hp, wp = h + 2 * ph, w + 2 * pw
+        ho, wo, _, _ = _out_geom(hp, wp, kh, kw, sh, sw)
+        jax.jit(
+            functools.partial(_bwd_padded, kh=kh, kw=kw, sh=sh, sw=sw)
+        ).lower(
+            jax.ShapeDtypeStruct((b, hp, wp, c), dtype),
+            jax.ShapeDtypeStruct((b, ho, wo, c), dtype),
+        ).compile()
+        return True
+    except Exception as e:
+        warnings.warn(
+            "Pallas max-pool backward failed to compile for "
+            f"x={x_shape} k=({kh},{kw}) s=({sh},{sw}) p=({ph},{pw}); "
+            f"using the XLA backward instead. Error: {str(e)[:400]}"
+        )
+        return False
+
+
+def usable(x, kh, kw, sh, sw, ph, pw) -> bool:
+    if pool_pallas_mode() == "off":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if x.ndim != 4:
+        return False
+    if not supported(tuple(x.shape), kh, kw, sh, sw, ph, pw, x.dtype.itemsize):
+        return False
+    return _compiles(
+        tuple(x.shape), jnp.dtype(x.dtype).name, kh, kw, sh, sw, ph, pw
+    )
+
+
+def dispatchable(x, kh, kw, sh, sw, ph, pw) -> bool:
+    """``usable`` + not under a batched (vmapped) trace. The pipeline's
+    micro-batched front vmaps the cell stack; a batched ``pallas_call``
+    compiles through an added grid dimension only sometimes, and the
+    compile probe (which runs on the UN-batched shape) cannot vouch for
+    it — so batched contexts keep the XLA/tree backward, exactly like the
+    halo kernel's policy (``parallel/halo.py:124-146``). The sniffs are
+    shared with that policy: the pipeline front's ``xla_halo_only``
+    context, plus a direct batch-tracer check."""
+    from mpi4dl_tpu.parallel.halo import _is_batch_tracer, _xla_only_active
+
+    if _xla_only_active() or _is_batch_tracer(x):
+        return False
+    return usable(x, kh, kw, sh, sw, ph, pw)
+
+
+def _bwd_padded(xp, dy, *, kh, kw, sh, sw, interpret=False):
+    """dxp [B, Hp, Wp, C] from the padded input and the cotangent."""
+    b, hp, wp, c = xp.shape
+    _, ho, wo, _ = dy.shape
+    _, _, hp_eff, wp_eff = _out_geom(hp, wp, kh, kw, sh, sw)
+    plan = _plan(c, ho, wo, kh, kw, sh, sw, xp.dtype.itemsize)
+    assert plan is not None, (xp.shape, kh, kw, sh, sw)
+    to, cchunk = plan
+    nrows = ho // to
+    nc = c // cchunk
+    geo = _class_geometry(kh, kw, sh, sw)
+
+    # Windows cover padded rows/cols [0, hp_eff) x [0, wp_eff); anything
+    # past that (possible when the torch floor-mode output size leaves a
+    # trailing pad row uncovered, e.g. k3 s2 p1 on even sizes) gets zero
+    # gradient and is appended after the kernel. Parity planes are built
+    # HERE (XLA-side strided slices): Mosaic rejects strided vector
+    # extracts in-kernel, and planes make every kernel slice contiguous.
+    xe = xp[:, :hp_eff, :wp_eff, :]
+
+    grid = (b * nrows * nc,)
+
+    def idx(i):
+        return (i // (nrows * nc), (i // nc) % nrows, i % nc)
+
+    in_specs, args = [], []
+    for (pr, pc), (dmax, emax) in geo.items():
+        plane = xe[:, pr::sh, pc::sw, :] if (sh, sw) != (1, 1) else xe
+        wpl = wo + emax
+        in_specs.append(
+            pl.BlockSpec(
+                (1, to, wpl, cchunk),
+                lambda i: (idx(i)[0], idx(i)[1], 0, idx(i)[2]),
+            )
+        )
+        args.append(plane)
+        if dmax:
+            # Overlap rows [ (i+1)*to, +dmax ) as an aligned block of
+            # height dmax (to % dmax == 0 via _plan).
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, dmax, wpl, cchunk),
+                    lambda i, d=dmax: (
+                        idx(i)[0], (idx(i)[1] + 1) * (to // d), 0, idx(i)[2]
+                    ),
+                )
+            )
+            args.append(plane)
+    in_specs.append(
+        pl.BlockSpec(
+            (1, to, wo, cchunk), lambda i: (idx(i)[0], idx(i)[1], 0, idx(i)[2])
+        )
+    )
+    args.append(dy)
+
+    out_specs, out_shapes = [], []
+    for (cr, cc_), (dmax, emax) in geo.items():
+        wc = wo + emax
+        out_specs.append(
+            pl.BlockSpec(
+                (1, to, wc, cchunk),
+                lambda i: (idx(i)[0], idx(i)[1], 0, idx(i)[2]),
+            )
+        )
+        out_shapes.append(jax.ShapeDtypeStruct((b, ho, wc, c), dy.dtype))
+        if dmax:
+            # 4-D, chunk-flattened: [b, nrows*dmax, wc, c] — a 5-D
+            # [b, nrows, dmax, ...] form was assigned VMEM memory space
+            # by the compiler and stack-allocated the whole array.
+            out_specs.append(
+                pl.BlockSpec(
+                    (1, dmax, wc, cchunk),
+                    lambda i: (idx(i)[0], idx(i)[1], 0, idx(i)[2]),
+                )
+            )
+            out_shapes.append(
+                jax.ShapeDtypeStruct((b, nrows * dmax, wc, c), dy.dtype)
+            )
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _pool_bwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw, to=to, wo=wo
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*args)
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    # Reassemble: fold tails into each class, then interleave the classes
+    # with one strided-set each (sh*sw sub-arrays, not kh*kw full-res
+    # scatter terms).
+    dxe = jnp.zeros((b, hp_eff, wp_eff, c), dy.dtype)
+    oi = 0
+    for (cr, cc_), (dmax, emax) in geo.items():
+        main = outs[oi]
+        oi += 1
+        if dmax:
+            tails = outs[oi]
+            oi += 1
+            wc = wo + emax
+            # Chunk i's tail rows are class rows (i+1)*to + [0, dmax) —
+            # the next chunk's first rows (to >= dmax via _plan's choices).
+            # Lay the tails on a to-strided grid shifted by to, add, crop
+            # back to the class extent ho + dmax.
+            sub = jnp.concatenate(
+                [main, jnp.zeros((b, to, wc, c), dy.dtype)], axis=1
+            )
+            flat = jnp.pad(
+                tails.reshape(b, nrows, dmax, wc, c),
+                ((0, 0), (0, 0), (0, to - dmax), (0, 0), (0, 0)),
+            )
+            flat = flat.reshape(b, nrows * to, wc, c)
+            sub = sub.at[:, to : to + ho].add(flat)
+            sub = sub[:, : ho + dmax]
+        else:
+            sub = main
+        # Class (cr, cc_) rows/cols of dxe are exactly sub's extent:
+        # ceil((hp_eff - cr)/sh) == ho + dmax, same in W.
+        dxe = dxe.at[:, cr :: sh, cc_ :: sw, :].add(sub)
+    if hp_eff < hp or wp_eff < wp:
+        dxe = jnp.pad(
+            dxe,
+            (
+                (0, 0),
+                (0, hp - hp_eff),
+                (0, wp - wp_eff),
+                (0, 0),
+            ),
+        )
+    return dxe
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def max_pool(x, kh, kw, sh, sw, ph, pw):
+    """Max pool (−inf edge padding, torch ``MaxPool2d`` parity) whose
+    backward is the one-pass Pallas kernel. Forward ==
+    ``lax.reduce_window(max)`` — the same values every other path here
+    produces; only the backward's tie rule (first-max-wins) differs from
+    the shifted-maximum tree's maximum-chain subgradients, which callers
+    gate on (see ``max_pool_s1_valid``)."""
+    return _fwd_val(x, kh, kw, sh, sw, ph, pw)
+
+
+def _fwd_val(x, kh, kw, sh, sw, ph, pw):
+    neg = jnp.asarray(_NEG, x.dtype)
+    xp = lax.pad(x, neg, ((0, 0, 0), (ph, ph, 0), (pw, pw, 0), (0, 0, 0)))
+    return lax.reduce_window(
+        xp, neg, lax.max, (1, kh, kw, 1), (1, sh, sw, 1), "valid"
+    )
+
+
+def _fwd(x, kh, kw, sh, sw, ph, pw):
+    # Residual is x alone: the backward recomputes each window's winner
+    # in-register (online argmax), so the pooled output never needs to
+    # be saved or re-read.
+    return _fwd_val(x, kh, kw, sh, sw, ph, pw), x
+
+
+def _bwd(kh, kw, sh, sw, ph, pw, x, dy):
+    neg = jnp.asarray(_NEG, x.dtype)
+    xp = lax.pad(x, neg, ((0, 0, 0), (ph, ph, 0), (pw, pw, 0), (0, 0, 0)))
+    dxp = _bwd_padded(xp, dy, kh=kh, kw=kw, sh=sh, sw=sw)
+    h, w = x.shape[1], x.shape[2]
+    return (dxp[:, ph : ph + h, pw : pw + w, :],)
+
+
+max_pool.defvjp(_fwd, _bwd)

@@ -1,0 +1,93 @@
+"""Pallas one-pass max-pool backward vs XLA's reduce_window gradient
+(interpreter mode — same math on CPU; the TPU lowering is exercised by
+the compile probe + bench runs).
+
+The kernel's tie rule is row-major first-max-wins == XLA's
+``select_and_scatter``, so with integer-valued cotangents (float sums
+exact regardless of accumulation order) the comparison is bit-exact even
+on tie-heavy integer inputs.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.ops import pool_pallas
+
+
+def _kernel_dx(x, dy, kh, kw, sh, sw, ph, pw):
+    neg = jnp.asarray(float("-inf"), x.dtype)
+    xp = jax.lax.pad(
+        x, neg, ((0, 0, 0), (ph, ph, 0), (pw, pw, 0), (0, 0, 0))
+    )
+    dxp = pool_pallas._bwd_padded(
+        xp, dy, kh=kh, kw=kw, sh=sh, sw=sw, interpret=True
+    )
+    h, w = x.shape[1], x.shape[2]
+    return dxp[:, ph : ph + h, pw : pw + w, :]
+
+
+def _xla_dx(x, dy, kh, kw, sh, sw, ph, pw):
+    f = functools.partial(
+        pool_pallas._fwd_val, kh=kh, kw=kw, sh=sh, sw=sw, ph=ph, pw=pw
+    )
+    _, vjp = jax.vjp(f, x)
+    (dx,) = vjp(dy)
+    return dx
+
+
+@pytest.mark.parametrize(
+    "shape,k,s,p,tie_heavy",
+    [
+        ((2, 16, 16, 8), 3, 1, 1, True),  # normal-cell 3x3 s1 pool
+        ((2, 16, 16, 8), 3, 1, 1, False),
+        ((1, 18, 18, 8), 3, 1, 0, True),  # pre-padded VALID form
+        ((2, 16, 16, 8), 3, 2, 1, True),  # reduction-cell 3x3 s2 pool
+        ((2, 16, 16, 8), 3, 2, 1, False),  # (even size: uncovered pad row)
+        ((1, 8, 32, 16), 3, 1, 1, True),  # rectangular
+        ((1, 32, 8, 128), 3, 2, 1, True),
+    ],
+)
+def test_bwd_matches_select_and_scatter(shape, k, s, p, tie_heavy):
+    rng = np.random.default_rng(0)
+    if tie_heavy:
+        x = jnp.asarray(rng.integers(0, 3, size=shape), jnp.float32)
+    else:
+        x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    ho = (shape[1] + 2 * p - k) // s + 1
+    wo = (shape[2] + 2 * p - k) // s + 1
+    dy = jnp.asarray(
+        rng.integers(-64, 64, size=(shape[0], ho, wo, shape[3])), jnp.float32
+    )
+    assert pool_pallas.supported(shape, k, k, s, s, p, p, 4)
+    got = _kernel_dx(x, dy, k, k, s, s, p, p)
+    want = _xla_dx(x, dy, k, k, s, s, p, p)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_forward_matches_tree():
+    from mpi4dl_tpu.ops.layers import max_pool_s1_valid
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 18, 18, 8)), jnp.float32)
+    y_tree = max_pool_s1_valid(x, 3, 3)  # CPU: tree path (pallas not usable)
+    y_pool = pool_pallas._fwd_val(x, 3, 3, 1, 1, 0, 0)
+    np.testing.assert_array_equal(np.asarray(y_tree), np.asarray(y_pool))
+
+
+def test_gates(monkeypatch):
+    # non-overlapping windows: XLA's backward is fine, kernel declines
+    assert not pool_pallas.supported((2, 16, 16, 8), 2, 2, 2, 2, 0, 0)
+    # CPU backend: usable() is False even for supported shapes
+    x = jnp.zeros((2, 16, 16, 8), jnp.float32)
+    if jax.default_backend() != "tpu":
+        assert not pool_pallas.usable(x, 3, 3, 1, 1, 1, 1)
+    # env off-switch
+    monkeypatch.setenv("MPI4DL_TPU_POOL_PALLAS", "off")
+    assert not pool_pallas.usable(x, 3, 3, 1, 1, 1, 1)
+    monkeypatch.setenv("MPI4DL_TPU_POOL_PALLAS", "bogus")
+    with pytest.raises(ValueError):
+        pool_pallas.pool_pallas_mode()
