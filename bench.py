@@ -91,8 +91,10 @@ def _is_transient_failure(msg: str) -> bool:
         "Broken pipe",
         "Remote end closed",
         "EOF occurred",
-        "timed out",
         "Temporary failure",
+        # NOT "timed out": a compile-helper deadline on a too-large
+        # program is deterministic — classifying it transient would buy
+        # a doomed ~10-min retry and a mislabeled skip message.
     )
     return any(n in msg for n in needles)
 

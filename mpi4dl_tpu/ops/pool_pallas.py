@@ -67,6 +67,31 @@ def pool_pallas_mode() -> str:
     return mode
 
 
+_DISABLED = [False]
+
+
+class disable:
+    """Trace-time off-switch for the pool kernel dispatch (context
+    manager, same pattern as ``fastconv.wgrad_taps_threshold``).
+
+    ``Trainer.train_step`` arms this for images >= 2048px: per-shape the
+    kernels pass their gates there, but injecting VMEM-stack-allocated
+    custom-call results into a program already compiled against the HBM
+    ceiling kills the compile helper (measured: AmoebaNet@2048 bs1
+    compiles with the kernels off, dies with them on — round 4). The
+    @1024 headline regime, where the kernel is measured bit-exact at
+    end-to-end parity, keeps the dispatch. ``MPI4DL_TPU_POOL_PALLAS=off``
+    disables everywhere regardless."""
+
+    def __enter__(self):
+        self._prev = _DISABLED[0]
+        _DISABLED[0] = True
+
+    def __exit__(self, *exc):
+        _DISABLED[0] = self._prev
+        return False
+
+
 def _class_geometry(kh, kw, sh, sw):
     """Per parity class (cr, cc): max row/col shift (D, E). Class (cr, cc)
     holds dx rows r ≡ cr (mod sh) / cols ≡ cc (mod sw); tap (u, v) with
@@ -217,6 +242,13 @@ def supported(x_shape, kh, kw, sh, sw, ph, pw, itemsize=2) -> bool:
     b, h, w, c = x_shape
     if kh <= sh and kw <= sw:
         return False  # non-overlapping: XLA's backward is already a reshape
+    # This runtime's AOT compiler stack-allocates Pallas custom-call
+    # results in VMEM (docs/PERF.md round 4), so the kernel's output set
+    # (~dx-sized) must fit well under the 128 MB VMEM alongside the
+    # working set. Gate cheaply here instead of paying a doomed 10-30 s
+    # compile probe per >=2048px pool shape during bench runs.
+    if b * (h + 2 * ph) * (w + 2 * pw) * c * itemsize > 100 * 1024 * 1024:
+        return False
     hp, wp = h + 2 * ph, w + 2 * pw
     if hp < kh or wp < kw:
         return False
@@ -274,7 +306,7 @@ def dispatchable(x, kh, kw, sh, sw, ph, pw) -> bool:
     context, plus a direct batch-tracer check."""
     from mpi4dl_tpu.parallel.halo import _is_batch_tracer, _xla_only_active
 
-    if _xla_only_active() or _is_batch_tracer(x):
+    if _DISABLED[0] or _xla_only_active() or _is_batch_tracer(x):
         return False
     return usable(x, kh, kw, sh, sw, ph, pw)
 

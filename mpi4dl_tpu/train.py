@@ -813,18 +813,29 @@ class Trainer:
         return put_global(self.mesh, (self.x_spec, self.y_spec), x, y)
 
     def train_step(self, state: TrainState, x, y):
+        from contextlib import ExitStack
+
+        from mpi4dl_tpu.ops import pool_pallas
         from mpi4dl_tpu.ops.fastconv import wgrad_taps_threshold
 
-        if self.config.image_size >= 3072:
-            # Arm the aggressive per-tap wgrad gate for this trace: at
-            # these sizes the backward-filter conv's padded operand
-            # copies are what OOMs the step (docs/PERF.md round 4). A
-            # trace-time context, not process state — other Trainers in
-            # the process keep the 3072 MB default; the env override
-            # still wins inside taps_min_mb.
-            with wgrad_taps_threshold(256):
-                return call_with_halo_hint(self._jit_step, state, x, y)
-        return call_with_halo_hint(self._jit_step, state, x, y)
+        with ExitStack() as stack:
+            if self.config.image_size >= 3072:
+                # Arm the aggressive per-tap wgrad gate for this trace:
+                # at these sizes the backward-filter conv's padded
+                # operand copies are what OOMs the step (docs/PERF.md
+                # round 4). A trace-time context, not process state —
+                # other Trainers in the process keep the 3072 MB
+                # default; the env override still wins inside
+                # taps_min_mb.
+                stack.enter_context(wgrad_taps_threshold(256))
+            if self.config.image_size >= 2048:
+                # Keep the Pallas pool backward out of large-image
+                # programs: its VMEM-stack-allocated results kill the
+                # compile against the HBM ceiling (measured:
+                # AmoebaNet@2048 bs1 compiles with it off, dies with it
+                # on — pool_pallas.disable docstring).
+                stack.enter_context(pool_pallas.disable())
+            return call_with_halo_hint(self._jit_step, state, x, y)
 
 
 def call_with_halo_hint(fn, *args):

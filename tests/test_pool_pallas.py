@@ -91,3 +91,18 @@ def test_gates(monkeypatch):
     monkeypatch.setenv("MPI4DL_TPU_POOL_PALLAS", "bogus")
     with pytest.raises(ValueError):
         pool_pallas.pool_pallas_mode()
+
+
+def test_disable_context():
+    """Trainer arms pool_pallas.disable() for >=2048px traces: injecting
+    the kernel's VMEM-stack-allocated results into a program compiled
+    against the HBM ceiling kills the compile helper (round-4 incident:
+    AmoebaNet@2048 bs1 compiled with the kernels off, died with them on).
+    The context must gate dispatchable() regardless of backend."""
+    x = jnp.zeros((2, 18, 18, 8), jnp.float32)
+    with pool_pallas.disable():
+        assert not pool_pallas.dispatchable(x, 3, 3, 1, 1, 0, 0)
+        with pool_pallas.disable():  # re-entrant
+            assert not pool_pallas.dispatchable(x, 3, 3, 1, 1, 0, 0)
+        assert not pool_pallas.dispatchable(x, 3, 3, 1, 1, 0, 0)
+    assert not pool_pallas._DISABLED[0]
