@@ -443,13 +443,30 @@ class Trainer:
 
     @staticmethod
     def _compact(tree):
-        """[B, H, W, C] leaves → [B, H, W*C] (the 128-lane pad-tax dodge for
-        scan carries/residuals); other ranks pass through. Returns
+        """[B, H, W, C] leaves → [B, H, W*C] (the 128-lane pad-tax dodge
+        for scan carries/residuals) — but only where the tax is real:
+        leaves whose stored padding factor ceil(C/128)*128/C is >= 2
+        (ResNet stage carries: C=16/32/64 pay 8x/4x/2x; note C=65..127
+        pays up to 1.97x and stays 4-D under this gate — a model carrying
+        such widths at fit-barely resolutions trades carry HBM for the
+        reshape cost below). AmoebaNet's >=104-channel carries pay at
+        most 1.23x, and the flatten around them was far worse than its
+        reshape self-time: Pallas custom calls can't fuse, so every pool
+        kernel operand/result paid a full-res relayout at the carry
+        boundary — un-flattening them measured +15.5% end-to-end on the
+        @1024 headline (docs/PERF.md round-4 "flatten interaction").
+        Other ranks pass through. Returns
         (compact_tree, (treedef, shape_list)) for :meth:`_restore`."""
+
+        def pad_tax(c: int) -> float:
+            return (-(-c // 128) * 128) / c
+
         leaves, treedef = jax.tree.flatten(tree)
         shapes = [tuple(a.shape) for a in leaves]
         out = [
-            a.reshape(a.shape[0], a.shape[1], -1) if a.ndim == 4 else a
+            a.reshape(a.shape[0], a.shape[1], -1)
+            if a.ndim == 4 and pad_tax(a.shape[-1]) >= 2
+            else a
             for a in leaves
         ]
         return jax.tree.unflatten(treedef, out), (treedef, shapes)

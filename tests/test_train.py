@@ -272,12 +272,23 @@ def test_local_dp_without_lp_stage_rejected():
 
 
 @pytest.mark.slow
-def test_scan_remat_amoebanet_tuple_state_matches_golden():
+@pytest.mark.parametrize(
+    "num_filters",
+    [32, pytest.param(288, marks=pytest.mark.slow)],  # 288F: ~4 min on CPU
+)
+def test_scan_remat_amoebanet_tuple_state_matches_golden(num_filters):
     """The "scan" planner accepts pytree (tuple-state) fixed points: an
     AmoebaNet run of identical normal cells rewrites into one stacked-param
     lax.scan whose carry is the ``(concat, skip)`` tuple — round-1 VERDICT
     weak: the planner only accepted single tensors, so AmoebaNet degenerated
     to per-cell checkpointing.
+
+    num_filters=288 puts every carry leaf past the 64-channel pad-tax
+    boundary, so the scan runs with 4-D (un-flattened) carries — the
+    branch of ``Trainer._compact`` that real AmoebaNet-D (416F) takes by
+    default since the round-4 conditional flatten (review finding: the
+    32F case flattens every leaf, leaving the pass-through path covered
+    only by on-TPU benches).
 
     Comparison is loss + one-step GRADIENTS at relative tolerance, not
     multi-step parameters: an untrained AmoebaNet's input-side gradients
@@ -289,7 +300,7 @@ def test_scan_remat_amoebanet_tuple_state_matches_golden():
     conditioning makes even same-math program pairs diverge visibly."""
     from mpi4dl_tpu.models.amoebanet import amoebanetd
 
-    cells = amoebanetd(num_classes=10, num_layers=12, num_filters=32)
+    cells = amoebanetd(num_classes=10, num_layers=12, num_filters=num_filters)
     cfg = ParallelConfig(batch_size=2, split_size=1, spatial_size=0, image_size=64)
     trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat="scan")
     state = trainer.init(jax.random.PRNGKey(5), (2, 32, 32, 3))
@@ -543,3 +554,22 @@ def test_save_budget_spatial_matches_golden(monkeypatch):
         float(metrics["loss"]), float(golden_metrics["loss"]), rtol=1e-5
     )
     _assert_tree_close(state.params, golden_state.params, rtol=2e-4, atol=1e-5)
+
+
+def test_compact_restore_mixed_tree_roundtrip():
+    """_compact flattens only leaves whose lane-pad factor is >= 2; a
+    mixed tree (C=16 flattens, C=72 passes through 4-D) must round-trip
+    exactly through _restore (round-4 conditional flatten)."""
+    rng = np.random.default_rng(0)
+    tree = {
+        "narrow": jnp.asarray(rng.standard_normal((2, 4, 4, 16)), jnp.float32),
+        "wide": jnp.asarray(rng.standard_normal((2, 4, 4, 72)), jnp.float32),
+        "vec": jnp.asarray(rng.standard_normal((7,)), jnp.float32),
+    }
+    compact, meta = Trainer._compact(tree)
+    assert compact["narrow"].shape == (2, 4, 4 * 16)   # tax 8x: flattened
+    assert compact["wide"].shape == (2, 4, 4, 72)      # tax 1.78x: kept 4-D
+    assert compact["vec"].shape == (7,)
+    restored = Trainer._restore(compact, meta)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(restored[k]), np.asarray(tree[k]))
