@@ -77,13 +77,38 @@ def _git_rev() -> str:
         return "unknown"
 
 
-def _is_transient_failure(msg: str) -> bool:
+def _exception_chain_text(e) -> str:
+    """str(e) plus every chained __cause__/__context__ message: a
+    transport flake wrapped in an exception whose own message lacks the
+    signature must still classify as transient (ADVICE r4). Both branches
+    are walked — a node with an explicit __cause__ can still carry the
+    flake in its __context__ (raise ... from other inside an except)."""
+    parts, seen, todo = [], set(), [e]
+    while todo:
+        exc = todo.pop()
+        if exc is None or id(exc) in seen:
+            continue
+        seen.add(id(exc))
+        parts.append(str(exc))
+        todo.extend((exc.__cause__, exc.__context__))
+    return "\n".join(parts)
+
+
+def _is_transient_failure(exc_or_msg) -> bool:
     """Transport/infrastructure flakes from the tunneled compile helper —
     failures that say nothing about whether the PROGRAM can compile, so
     they must never produce a "confirmed" known-fatal verdict. The
     signatures are from observed incidents on this runtime; a genuine
     compile failure surfaces as ``tpu_compile_helper subprocess exit
-    code 1`` (HBM OOM, Mosaic rejection...) and is NOT in this list."""
+    code 1`` (HBM OOM, Mosaic rejection...) and is NOT in this list.
+
+    Accepts an exception (scans the whole __cause__/__context__ chain)
+    or a plain string."""
+    msg = (
+        exc_or_msg
+        if isinstance(exc_or_msg, str)
+        else _exception_chain_text(exc_or_msg)
+    )
     needles = (
         "response body closed",
         "read body:",
@@ -610,9 +635,10 @@ def main():
                 except Exception as e:  # noqa: BLE001 — walk stops here
                     msg = f"{type(e).__name__}: {str(e)[:120]}"
                     record(None, None, f"{size}: {msg}")
-                    # Classify on the UNTRUNCATED text: wrapped transport
-                    # errors can carry their signature past any prefix.
-                    if _is_transient_failure(str(e)):
+                    # Classify on the UNTRUNCATED text of the whole
+                    # exception chain: wrapped transport errors can carry
+                    # their signature past any prefix or in a __cause__.
+                    if _is_transient_failure(e):
                         # Tunnel/helper transport flake ("response body
                         # closed", connection reset...): proves nothing
                         # about the program. Leave the marker PROVISIONAL

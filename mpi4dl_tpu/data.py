@@ -105,6 +105,60 @@ class SyntheticImages:
             stop.set()  # runs on generator close/GC too — unblocks producer
 
 
+class ClassPatternImages:
+    """Learnable deterministic dataset: each class has a fixed smooth
+    pattern template, each sample = its class template + Gaussian noise.
+
+    This exists for convergence evidence (the reference's ``--app 2``
+    CIFAR-10 path, ``benchmark_amoebanet_sp.py:264-306``, plays this role
+    on a cluster with data; the benchmark machine has no egress, so the
+    learnable signal is synthesized): a model that learns ANYTHING drives
+    loss below ln(num_classes) and accuracy above 1/num_classes within a
+    few hundred SGD steps, and a resumed run must continue the same curve.
+    Pure numpy, fully determined by ``seed`` — two processes construct
+    bit-identical streams, which is what makes kill/resume curves
+    comparable across process boundaries.
+    """
+
+    def __init__(
+        self,
+        batch_size,
+        image_size,
+        num_classes,
+        length=60000,
+        seed=0,
+        noise=0.25,
+    ):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.length = length
+        self.seed = seed
+        self.noise = noise
+        # Low-frequency templates: random coarse grids upsampled to the
+        # image size, so the signal survives pooling/striding.
+        rng = np.random.default_rng(seed ^ 0x5EED)
+        coarse = rng.standard_normal((num_classes, 4, 4, 3)).astype(np.float32)
+        reps = (image_size + 3) // 4
+        up = np.repeat(np.repeat(coarse, reps, axis=1), reps, axis=2)
+        self._templates = up[:, :image_size, :image_size, :]
+
+    def __len__(self):
+        return max(self.length // self.batch_size, 1)
+
+    def batch(self, i):
+        rng = np.random.default_rng(self.seed * 1_000_003 + i)
+        y = rng.integers(0, self.num_classes, size=(self.batch_size,))
+        x = self._templates[y] + self.noise * rng.standard_normal(
+            (self.batch_size, self.image_size, self.image_size, 3)
+        ).astype(np.float32)
+        return x.astype(np.float32), y.astype(np.int32)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.batch(i)
+
+
 def _torchvision_loader(kind, args, batch_size, shard_id=0, num_shards=1):
     import torch
     import torchvision

@@ -438,16 +438,19 @@ def max_pool_s1_valid(x, kh: int, kw: int):
     Numerically identical forward to ``lax.reduce_window(max)``, but the
     backward lowers to selects + pads instead of ``select_and_scatter`` —
     measured 17% of the AmoebaNet train step on TPU (docs/PERF.md round 3);
-    the genotype runs a 3×3 s1 max pool in every cell. Tie-breaking of the
-    gradient differs from ``select_and_scatter`` (maximum-chain subgradients
-    vs first-window-element); every model path (plain, spatial, D2) uses
-    THIS implementation for stride-1 pools, so golden comparisons are
-    impl-consistent, like the reference's CUDA pooling is with itself.
+    the genotype runs a 3×3 s1 max pool in every cell.
 
-    On TPU, shapes the one-pass Pallas backward admits dispatch to
-    :mod:`mpi4dl_tpu.ops.pool_pallas` instead (identical forward values;
-    first-max-wins backward — the ``select_and_scatter`` tie rule); the
-    tree stays the CPU/test path and the fallback.
+    Gradient tie-breaking is impl-consistent **per backend**, not globally:
+    on CPU (and wherever the Pallas gate declines) every model path (plain,
+    spatial, D2) uses the tree backward (maximum-chain subgradients), so
+    same-backend golden comparisons are impl-consistent, like the
+    reference's CUDA pooling is with itself. On TPU, shapes the one-pass
+    Pallas backward admits dispatch to :mod:`mpi4dl_tpu.ops.pool_pallas`
+    instead (identical forward values; first-max-wins backward — the
+    ``select_and_scatter`` tie rule). Cross-backend gradient comparisons on
+    tie-heavy data (e.g. bf16) must therefore run with
+    ``MPI4DL_TPU_POOL_PALLAS=off``; the tree stays the CPU/test path and
+    the fallback.
     """
     from mpi4dl_tpu.ops import pool_pallas
 

@@ -41,7 +41,9 @@ made the XLA-level decomposition 32% SLOWER end-to-end,
 Dispatch: ``usable()`` = shape gate + cached on-device compile probe
 (Mosaic failures only surface on real hardware); fallbacks are the
 existing tree / reduce_window paths, so the step cannot be broken by a
-kernel regression. ``MPI4DL_TPU_POOL_PALLAS=off`` disables for A/B.
+kernel regression. ``MPI4DL_TPU_POOL_PALLAS=off`` disables for A/B;
+``=on`` additionally neutralizes trainer-armed ``disable()`` heuristics
+(the >=2048px gate) for A/B re-validation.
 """
 
 from __future__ import annotations
@@ -59,10 +61,16 @@ _VMEM_BUDGET = 10 * 1024 * 1024
 
 
 def pool_pallas_mode() -> str:
+    """auto: shape/probe gates decide, and trainers may arm ``disable()``
+    heuristics (e.g. the >=2048px gate). off: never dispatch. on: like
+    auto but ``disable()`` becomes a no-op, so the >=2048px heuristic can
+    be A/B-revalidated if the compiler/runtime VMEM behavior improves —
+    correctness gates (shape plan, compile probe, batched traces) still
+    apply."""
     mode = os.environ.get("MPI4DL_TPU_POOL_PALLAS", "auto")
-    if mode not in ("auto", "off"):
+    if mode not in ("auto", "off", "on"):
         raise ValueError(
-            f"MPI4DL_TPU_POOL_PALLAS must be auto|off, got {mode!r}"
+            f"MPI4DL_TPU_POOL_PALLAS must be auto|off|on, got {mode!r}"
         )
     return mode
 
@@ -81,11 +89,13 @@ class disable:
     compiles with the kernels off, dies with them on — round 4). The
     @1024 headline regime, where the kernel is measured bit-exact at
     end-to-end parity, keeps the dispatch. ``MPI4DL_TPU_POOL_PALLAS=off``
-    disables everywhere regardless."""
+    disables everywhere regardless; ``=on`` makes THIS switch a no-op so
+    the heuristics that arm it can be A/B-revalidated."""
 
     def __enter__(self):
         self._prev = _DISABLED[0]
-        _DISABLED[0] = True
+        if pool_pallas_mode() != "on":
+            _DISABLED[0] = True
 
     def __exit__(self, *exc):
         _DISABLED[0] = self._prev
@@ -118,7 +128,10 @@ def _pool_bwd_kernel(*refs, kh, kw, sh, sw, to, wo):
     [1, to, Wp_p, Cc] and — when the plane has row spill D > 0 — a tail
     ref [1, D, Wp_p, Cc]; then the dy ref [1, to, Wo, Cc]; then the
     outputs: per class a main ref [1, to, Wc, Cc] and (D > 0) a tail ref
-    [1, 1, D, Wc, Cc]. Input planes and output classes share the same
+    [1, D, Wc, Cc] carved from a 4-D chunk-flattened [b, nrows*D, Wc, C]
+    array (a 5-D [b, nrows, D, Wc, C] form was rejected: the compiler
+    assigned it VMEM memory space and stack-allocated the whole array —
+    see the out_specs comment). Input planes and output classes share the same
     parity geometry: tap (u, v) lives on plane (u%sh, v%sw) at offset
     (u//sh, v//sw), and scatters window (a, b) to dx class (u%sh, v%sw)
     at the same offset — dx is in input coordinates.

@@ -180,3 +180,29 @@ def test_transient_signature_past_truncation_still_classified():
     assert bench._is_transient_failure(
         long_prefix + "read body: response body closed before all bytes"
     )
+
+
+def test_transient_signature_in_cause_chain_still_classified():
+    """A transport flake wrapped in an exception whose OWN message lacks
+    the signature must classify via __cause__/__context__ (ADVICE r4)."""
+    bench = _load_bench()
+    try:
+        try:
+            raise OSError("Connection reset by peer")
+        except OSError as inner:
+            raise RuntimeError("remote compile failed") from inner
+    except RuntimeError as e:
+        wrapped = e
+    assert "Connection reset" not in str(wrapped)
+    assert bench._is_transient_failure(wrapped)
+    # Implicit chaining (__context__) counts too.
+    try:
+        try:
+            raise OSError("Broken pipe")
+        except OSError:
+            raise ValueError("helper died")
+    except ValueError as e:
+        ctx = e
+    assert bench._is_transient_failure(ctx)
+    # A plain string still works, and a clean exception stays fatal.
+    assert not bench._is_transient_failure(RuntimeError("Mosaic rejected op"))

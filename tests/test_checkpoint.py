@@ -74,6 +74,50 @@ def test_checkpoint_pruning_and_latest(tmp_path):
     assert latest_checkpoint(ckpt).endswith("step_00000004")
 
 
+def test_resume_continues_curve(tmp_path):
+    """The convergence-artifact logic (scripts/convergence_run.py) small on
+    CPU: train N steps, stop, restore into a FRESH trainer from the
+    checkpoint dir, continue the same deterministic stream — the combined
+    log must be step-contiguous, the loss must fall, and the resumed curve
+    must pick up where the stopped one left off (fast-tier stand-in for the
+    real-chip SIGKILL artifact, docs/artifacts/convergence_r5.json)."""
+    import importlib.util
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "convergence_run",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "convergence_run.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    run_phase = mod.run_phase
+
+    kw = dict(
+        depth=11,
+        image_size=16,
+        batch_size=16,
+        ckpt_dir=os.path.join(str(tmp_path), "ckpt"),
+        ckpt_every=6,
+        log_path=os.path.join(str(tmp_path), "curve.jsonl"),
+        lr=0.02,
+        compile_cache=False,
+    )
+    run_phase(steps=12, resume=False, **kw)
+    run_phase(steps=24, resume=True, **kw)
+
+    curve = [json.loads(l) for l in open(kw["log_path"])]
+    assert [r["step"] for r in curve] == list(range(1, 25))
+    first = np.mean([r["loss"] for r in curve[:3]])
+    last = np.mean([r["loss"] for r in curve[-3:]])
+    assert last < first, (first, last)
+    # Continuity at the kill/resume boundary: no restart-sized jump.
+    pre, post = curve[11]["loss"], curve[12]["loss"]
+    assert abs(post - pre) < max(0.5 * pre, 0.25), (pre, post)
+
+
 def test_step_timer_tracks_throughput():
     timer = StepTimer(batch_size=4, warmup=1)
     for _ in range(3):

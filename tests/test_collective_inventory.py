@@ -1,0 +1,126 @@
+"""Collective-inventory regression tests over compiled train-step HLO.
+
+Multi-chip *performance* is unmeasurable on this runtime (one real chip),
+but the communication *structure* is checkable: these tests compile the
+distributed train step on the 8-virtual-CPU mesh and pin the exact count
+of each collective op in the optimized HLO (VERDICT r4 next #7). A change
+that, say, doubles per-layer halo traffic or adds a stray resharding
+all-to-all fails here instead of silently shipping — the discipline the
+reference enforces by construction with its per-layer explicit
+isend/irecv pairs (``spatial.py:336-413``).
+
+If a test fails after an INTENTIONAL engine change: re-derive the counts
+(the probe is just ``trainer._jit_step.lower(...).compile().as_text()``),
+check the delta is explained by the change, and update the pins in the
+same commit.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.config import ParallelConfig
+from mpi4dl_tpu.models.resnet import get_resnet_v1
+from mpi4dl_tpu.train import Trainer
+
+OPS = (
+    "collective-permute",
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "reduce-scatter",
+)
+
+
+def _inventory(hlo: str) -> dict:
+    # Opcode position: space-delimited, directly before its operand paren
+    # (tuple result shapes contain spaces; operand uses like
+    # ``get-tuple-element(%all-to-all.4)`` must not count).
+    return {
+        op: len(re.findall(rf" {op}(?:-start)?\(", hlo)) for op in OPS
+    }
+
+
+def _batch(b, size):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, size, size, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(b,)), jnp.int32)
+    return x, y
+
+
+def test_pure_dp_inventory():
+    """DP=2, no spatial: gradient/metrics all-reduces only — any permute,
+    gather, or all-to-all means input/param sharding regressed."""
+    cfg = ParallelConfig(
+        batch_size=4, split_size=1, spatial_size=0, image_size=32,
+        data_parallel=2,
+    )
+    cells = get_resnet_v1(depth=8)
+    tr = Trainer(cells, num_spatial_cells=0, config=cfg)
+    state = tr.init(jax.random.PRNGKey(0), (4, 32, 32, 3))
+    xs, ys = tr.shard_batch(*_batch(4, 32))
+    inv = _inventory(tr._jit_step.lower(state, xs, ys).compile().as_text())
+    assert inv == {
+        "collective-permute": 0,
+        "all-gather": 0,
+        "all-reduce": 2,  # fused grad bundle + loss/acc psum
+        "all-to-all": 0,
+        "reduce-scatter": 0,
+    }, inv
+
+
+def test_spatial_trainer_inventory():
+    """SP 2×2 tiles, 3 spatial cells (5 halo-exchanged 3×3 convs: stem +
+    2 CellV1 × 2). Halo traffic rides collective-permutes (4 shift
+    ppermutes per exchange forward, partially deduped with the backward's
+    transposed shifts by XLA); the SP→LP join is the tiled all_gather
+    pair (value + the backward's re-gather)."""
+    cfg = ParallelConfig(
+        batch_size=4, split_size=1, spatial_size=1, num_spatial_parts=(4,),
+        slice_method="square", image_size=32, data_parallel=1,
+    )
+    plain = get_resnet_v1(depth=8)
+    cells = get_resnet_v1(depth=8, spatial_cells=3)
+    tr = Trainer(cells, num_spatial_cells=3, config=cfg, plain_cells=plain)
+    state = tr.init(jax.random.PRNGKey(0), (4, 32, 32, 3))
+    xs, ys = tr.shard_batch(*_batch(4, 32))
+    inv = _inventory(tr._jit_step.lower(state, xs, ys).compile().as_text())
+    assert inv == {
+        "collective-permute": 36,  # ~4/exchange fwd + bwd over 5 conv layers
+        "all-gather": 2,  # tile join (fwd) + its backward re-gather
+        "all-reduce": 11,  # cross-tile BN stats + grad bundle + loss/acc
+        "all-to-all": 0,
+        "reduce-scatter": 2,
+    }, inv
+
+
+@pytest.mark.slow
+def test_sp_plus_lp_pipeline_inventory():
+    """SP front (2×2 tiles) + LP stage, parts=2 micro-batches: the
+    pipeline's stage ppermutes ride the same collective-permute class as
+    the halo shifts; the join all_gather pair and grad reductions must
+    not multiply with the schedule."""
+    from mpi4dl_tpu.parallel.pipeline import PipelineTrainer
+
+    cfg = ParallelConfig(
+        batch_size=4, parts=2, split_size=2, spatial_size=1,
+        num_spatial_parts=(4,), slice_method="square", image_size=32,
+        data_parallel=1,
+    )
+    plain = get_resnet_v1(depth=8)
+    n_sp = PipelineTrainer.spatial_cell_count(len(plain), cfg)
+    cells = get_resnet_v1(depth=8, spatial_cells=n_sp)
+    tr = PipelineTrainer(cells, cfg, plain_cells=plain)
+    state = tr.init(jax.random.PRNGKey(0))
+    xs, ys = tr.shard_batch(*_batch(4, 32))
+    inv = _inventory(tr._jit_step.lower(state, xs, ys).compile().as_text())
+    assert inv == {
+        "collective-permute": 20,
+        "all-gather": 2,
+        "all-reduce": 7,
+        "all-to-all": 0,
+        "reduce-scatter": 2,
+    }, inv

@@ -453,6 +453,58 @@ def test_grad_accum_matches_golden(accum):
     _assert_tree_close(state.params, want_params, rtol=1e-4, atol=1e-6)
 
 
+def test_dp_times_grad_accum_matches_unchunked_dp():
+    """DP=2 × grad_accum=2 == unchunked DP=2 (parameter equality; BN-free
+    cells so per-chunk batch statistics can't mask a reduction bug — with
+    linear loss normalization, mean-of-chunk-grads equals the full-batch
+    gradient exactly). Also pins what the chunk reshape EMITS on a
+    DP-sharded batch (train.py ``_accum_grads`` caveat): each contiguous
+    chunk lives on one device, so feeding it back through the
+    batch-sharded loss inserts exactly one resharding ``all-to-all`` per
+    input (x and y — 2 total), and the unchunked step has none. A change
+    that doubles the resharding traffic fails here. Measured cost note in
+    docs/PERF.md round 5."""
+    from test_collective_inventory import _inventory
+
+    def build():
+        return [
+            Conv2d(features=8, kernel_size=3),
+            Pool(kind="max", kernel_size=2),
+            Conv2d(features=16, kernel_size=3, strides=2),
+            Dense(10),
+        ]
+
+    cfg = ParallelConfig(
+        batch_size=8, split_size=1, spatial_size=0, image_size=16,
+        data_parallel=2,
+    )
+    x, y = _batch(b=8, size=16)
+    states, hlos = [], []
+    for accum in (1, 2):
+        trainer = Trainer(
+            build(), num_spatial_cells=0, config=cfg, grad_accum=accum
+        )
+        state = trainer.init(jax.random.PRNGKey(3), (8, 16, 16, 3))
+        xs, ys = trainer.shard_batch(x, y)
+        hlos.append(trainer._jit_step.lower(state, xs, ys).compile().as_text())
+        state, metrics = trainer.train_step(state, xs, ys)
+        states.append((jax.device_get(state.params), float(metrics["loss"])))
+
+    (p1, l1), (p2, l2) = states
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    _assert_tree_close(p1, p2, rtol=1e-4, atol=1e-6)
+
+    inv1, inv2 = _inventory(hlos[0]), _inventory(hlos[1])
+    assert inv1["all-to-all"] == 0
+    assert inv2["all-to-all"] == 2, (
+        "DP x grad_accum chunk resharding changed: expected one all-to-all "
+        f"per input (x, y), got {inv2}"
+    )
+    # Both steps reduce gradients the same way (psum-of-contributions);
+    # chunking must not multiply gradient reductions.
+    assert inv1["all-reduce"] == inv2["all-reduce"]
+
+
 def test_grad_accum_rejects_indivisible_batch():
     cells = [Dense(10)]
     cfg = ParallelConfig(batch_size=3, split_size=1, spatial_size=0, image_size=8)
