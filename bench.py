@@ -566,13 +566,21 @@ def main():
             if prior.get("value") is not None:
                 record(2048, prior["value"])
             for size in (3072, 4096, 8192):
-                # ≥3072px: the whole-model logarithmic-recursion policy —
-                # under plain "scan" the stored carries alone exceed HBM
-                # and the remote-compile helper dies at buffer assignment;
-                # scanlog is also 4x faster than scan2 at 3072 (0.165 vs
-                # 0.040 img/s — more headroom avoids the near-capacity
-                # stalls, docs/PERF.md round 4). BENCH_REMAT overrides.
-                walk_remats = [remat_pref] if remat_pref else ["scanlog"]
+                # 3072px: whole-model logarithmic recursion — under plain
+                # "scan" the stored carries alone exceed HBM and the
+                # remote-compile helper dies at buffer assignment; scanlog
+                # is also 4x faster than scan2 at 3072 (0.165 vs 0.040
+                # img/s, docs/PERF.md round 4). ≥4096px: straight to the
+                # anchored-quadratic "scanq" tier (O(1) live boundaries
+                # per run) — scanlog's ~23.7 GB live set is a confirmed
+                # OOM there and its doomed compile costs ~10 uncacheable
+                # minutes per attempt. BENCH_REMAT overrides.
+                if remat_pref:
+                    walk_remats = [remat_pref]
+                elif size >= 4096:
+                    walk_remats = ["scanq"]
+                else:
+                    walk_remats = ["scanlog", "scanq"]
                 # Key covers everything that shapes the compiled program —
                 # a different layout/dtype/policy A/B must not be skipped
                 # on another config's verdict.

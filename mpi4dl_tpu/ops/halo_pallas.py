@@ -1,5 +1,21 @@
 """Pallas TPU kernel for the halo exchange hot path.
 
+**Status: EXPERIMENTAL, off by default — recorded kill (round 5).** The
+kernel is correctness-tested (bit-identical to the XLA path on the
+8-device interpreter mesh, ``tests/test_halo_pallas.py``) but has never
+beaten the four-ppermute XLA path where it matters and cannot on this
+runtime: (a) the benchmark machine exposes ONE real chip, so the
+cross-chip ICI DMA race this kernel exists to win is unmeasurable here;
+(b) the same runtime's Pallas DMA path tops out ~10x below XLA's own
+copy kernels (measured, docs/PERF.md round 2 #2), so the local evidence
+points the wrong way; (c) under the pipeline's vmapped front the kernel
+deadlocks and auto-downgrades (below), excluding it from the schedules
+that dominate the benchmarks. The framework's transport story rests on
+XLA collectives plus the two Pallas kernels with measured end-to-end
+wins (``wgrad_pallas``, ``pool_pallas``); this module stays for a
+runtime where the ICI DMA path is competitive. Enable explicitly with
+``MPI4DL_TPU_HALO_IMPL=pallas``.
+
 The halo exchange is the innermost hot loop of spatial parallelism — the
 reference posts up to 8 tagged MPI isend/irecv per conv per micro-batch
 (``src/torchgems/spatial.py:336-413``) and even ships a (dead) compute-overlap

@@ -84,12 +84,17 @@ def main():
     peak = None
     size = args.start
     while size <= args.max:
-        if size >= 3072:
-            # Whole-model logarithmic recursion is the only policy whose
-            # live boundary set fits HBM here (Trainer._apply_cells_scanlog);
-            # larger sizes would waste a multi-minute doomed compile per
-            # leaner policy otherwise.
-            remats = ["scanlog"]
+        if size >= 4096:
+            # Straight to the anchored-quadratic tier: scanlog's live set
+            # is a confirmed OOM at 4096 (docs/PERF.md round 5) and its
+            # doomed compile costs ~10 uncacheable minutes per size.
+            remats = ["scanq"]
+        elif size >= 3072:
+            # Whole-model logarithmic recursion (fits and is 3.7x faster
+            # than scanq at 3072), then the anchored-quadratic tier whose
+            # live boundary set is O(1) per run; leaner policies would
+            # waste a multi-minute doomed compile per size here.
+            remats = ["scanlog", "scanq"]
         elif args.model == "amoebanet":
             remats = ["scan_save", "scan"]
         else:

@@ -168,6 +168,15 @@ def test_scanlog_matches_golden():
     test_scan2_nested_remat_matches_golden(remat="scanlog")
 
 
+def test_scanq_matches_golden():
+    """"scanq" (anchored-quadratic run backward, chain_quadratic): pure
+    scheduling — depth-44's 7-cell runs exercise the masked recompute
+    sweep and the per-cell vjp accumulation. The n=3 gate edge (depth-20's
+    3-cell runs) is covered by the slow-tier
+    ``test_remat_policies_match_golden[scanq]``."""
+    test_scan2_nested_remat_matches_golden(remat="scanq")
+
+
 def test_scan2_offload_matches_golden(monkeypatch):
     """MPI4DL_TPU_SCAN2_OFFLOAD=1 moves scan2's outer chunk boundaries to
     pinned host memory between forward and backward (the ≥4096px HBM
@@ -180,7 +189,8 @@ def test_scan2_offload_matches_golden(monkeypatch):
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "remat",
-    ["cell", "sqrt", "scan", "scan2", "scanlog", "scan_save", "group_save"],
+    ["cell", "sqrt", "scan", "scan2", "scanlog", "scanq", "scan_save",
+     "group_save"],
 )
 def test_remat_policies_match_golden(remat):
     """Every remat policy is a pure scheduling choice: losses, metrics, and
