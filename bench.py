@@ -390,10 +390,28 @@ def main():
             b if size >= 2048 and b > 1
             and not os.environ.get("BENCH_NO_ACCUM") else 1
         )
-        ips, remat = _train_throughput(
-            cells, size, b, steps, warmup, dtype,
-            remats_for(size, amoeba_remats), grad_accum=accum,
+        remats = remats_for(size, amoeba_remats)
+        budget_default = (
+            size >= 2048
+            and not remat_pref
+            and "MPI4DL_TPU_SAVE_BUDGET_MB" not in os.environ
         )
+        if budget_default:
+            # Budgeted scan_save at >=2048: the full save set OOMs but a
+            # 6000 MB grant compiles and measured +3% over plain "scan"
+            # twice across rounds (r4: 1.249 vs 1.215, r5: 1.447 vs
+            # 1.400 — docs/PERF.md round 5); "scan" stays the OOM
+            # fallback.
+            os.environ["MPI4DL_TPU_SAVE_BUDGET_MB"] = "6000"
+            remats = ["scan_save", "scan"]
+        try:
+            ips, remat = _train_throughput(
+                cells, size, b, steps, warmup, dtype,
+                remats, grad_accum=accum,
+            )
+        finally:
+            if budget_default:
+                del os.environ["MPI4DL_TPU_SAVE_BUDGET_MB"]
         util = mfu(
             ips, train_flops_per_image(cells, size, dtype),
             n_devices=jax.device_count(),
@@ -586,9 +604,15 @@ def main():
                 # on another config's verdict.
                 from mpi4dl_tpu.train import scan_unroll
 
+                # scanq program identity includes its store budget (set
+                # below for the attempt; default 3000).
+                qtag = (
+                    "_q" + os.environ.get("MPI4DL_TPU_SCANQ_STORE_MB", "3000")
+                    if "scanq" in walk_remats else ""
+                )
                 key = (
                     f"resnet110_{size}px_bs1_{'-'.join(walk_remats)}"
-                    f"_{layout}_{jnp.dtype(dtype).name}_u{scan_unroll()}"
+                    f"_{layout}_{jnp.dtype(dtype).name}_u{scan_unroll()}{qtag}"
                 )
                 skip = sentinel_skip_reason(
                     fatal.get(key), _git_rev(), _remaining(),
@@ -636,6 +660,16 @@ def main():
                     "killed mid-compile by the driver's budget",
                 }
                 write_sentinel()
+                # scanq attempts carry the measured store-budget default:
+                # 3000 MB grants the late small-carry runs the plain
+                # stored scan (+67% at 4096: 0.0594 vs 0.0355 img/s,
+                # docs/PERF.md round 5; 6000 MB OOMs). Env override wins.
+                scanq_default = (
+                    "scanq" in walk_remats
+                    and "MPI4DL_TPU_SCANQ_STORE_MB" not in os.environ
+                )
+                if scanq_default:
+                    os.environ["MPI4DL_TPU_SCANQ_STORE_MB"] = "3000"
                 try:
                     ips, _ = _train_throughput(
                         cells, size, 1, 3, 1, dtype, walk_remats
@@ -664,6 +698,9 @@ def main():
                         }
                     write_sentinel()
                     break
+                finally:
+                    if scanq_default:
+                        os.environ.pop("MPI4DL_TPU_SCANQ_STORE_MB", None)
                 fatal.pop(key, None)
                 write_sentinel()
                 record(size, round(ips, 3))

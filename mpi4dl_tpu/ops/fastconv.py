@@ -240,6 +240,20 @@ def _conv2d_s1_bwd(padding, res, dy):
     kh, kw, _, _ = w.shape
     (ph0, ph1), (pw0, pw1) = padding
 
+    if kh == 1 and kw == 1 and max(ph0, ph1, pw0, pw1) == 0:
+        # Fused one-pass Pallas backward where the dispatch admits it:
+        # dx and dw from ONE dy read (stock AD's two dots stream dy from
+        # HBM twice — the dominant HBM-bound cost class of the AmoebaNet
+        # step, docs/PERF.md round 5).
+        from mpi4dl_tpu.ops import dot1x1_pallas
+
+        if _on_tpu() and dot1x1_pallas.dispatchable(x, dy):
+            c, o = x.shape[-1], dy.shape[-1]
+            dx, dw = dot1x1_pallas.bwd_1x1(
+                x, dy, w.reshape(c, o)
+            )
+            return dx.astype(x.dtype), dw.reshape(1, 1, c, o).astype(w.dtype)
+
     big = (
         not (kh == 1 and kw == 1)  # the 1x1 dx IS the layout-safe 4-D dot
         and _wgrad_taps_profitable(

@@ -595,6 +595,7 @@ class Trainer:
                 self.remat == "scanq"
                 and len(run) >= 3
                 and ckpt is not _no_ckpt
+                and not self._scanq_store_granted(run, hc)
             ):
                 # Anchored-quadratic backward: O(1) live boundaries per
                 # run (the >3072px policy — chain_quadratic docstring).
@@ -616,6 +617,38 @@ class Trainer:
                 hc, _ = lax.scan(body, hc, stacked, unroll=unroll)
             h = self._restore(hc, shapes)
         return h
+
+    def _scanq_store_granted(self, run, hc) -> bool:
+        """``MPI4DL_TPU_SCANQ_STORE_MB`` (default 0 = off): under "scanq",
+        runs whose full carry set (len(run) x compact carry bytes) fits
+        the budget keep the plain checkpointed scan — storing a cheap
+        run's carries avoids its quadratic recompute while the expensive
+        runs stay anchored. Budget is consumed front-to-back per trace
+        (late small-activation stages free their carries before the early
+        stages' backward runs, so granting them is usually safe). A pure
+        scheduling choice; golden-tested with the budget set."""
+        budget_mb = float(os.environ.get("MPI4DL_TPU_SCANQ_STORE_MB", "0"))
+        if budget_mb <= 0:
+            return False
+        # Keyed by run identity (its first cell index — stable for a given
+        # scan plan), NOT by carry shape: two distinct same-shaped runs
+        # must EACH deduct the budget, while retraces of the same plan
+        # must reuse the original decision.
+        key = run[0]
+        if getattr(self, "_scanq_budget_key", None) != self._scan_plan_key:
+            self._scanq_budget_key = self._scan_plan_key
+            self._scanq_budget_left = budget_mb * 1e6
+            self._scanq_grants = {}
+        if key not in self._scanq_grants:
+            carry_bytes = sum(
+                int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.tree.leaves(hc)
+            ) * len(run)
+            granted = carry_bytes <= self._scanq_budget_left
+            if granted:
+                self._scanq_budget_left -= carry_bytes
+            self._scanq_grants[key] = granted
+        return self._scanq_grants[key]
 
     def _run_cell(self, i, p, h):
         """Apply cell ``i`` (inserting the SP→LP tile merge before cell
@@ -935,12 +968,16 @@ class Trainer:
                 # taps_min_mb.
                 stack.enter_context(wgrad_taps_threshold(256))
             if self.config.image_size >= 2048:
-                # Keep the Pallas pool backward out of large-image
-                # programs: its VMEM-stack-allocated results kill the
-                # compile against the HBM ceiling (measured:
-                # AmoebaNet@2048 bs1 compiles with it off, dies with it
-                # on — pool_pallas.disable docstring).
+                # Keep the Pallas pool + fused-1x1 backwards out of
+                # large-image programs: their VMEM-stack-allocated
+                # results kill the compile against the HBM ceiling
+                # (measured: AmoebaNet@2048 bs1 compiles with them off,
+                # dies with them on — pool_pallas.disable docstring;
+                # re-validated round 5 via MPI4DL_TPU_POOL_PALLAS=on).
+                from mpi4dl_tpu.ops import dot1x1_pallas
+
                 stack.enter_context(pool_pallas.disable())
+                stack.enter_context(dot1x1_pallas.disable())
             return call_with_halo_hint(self._jit_step, state, x, y)
 
 

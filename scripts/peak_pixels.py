@@ -112,9 +112,15 @@ def main():
             root=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             model=args.model, size=size, batch=args.batch, remats=remats,
         )
+        env = dict(os.environ)
+        if "scanq" in remats:
+            # Measured scanq default: grant the late small-carry runs
+            # stored carries (+67% at 4096; 6000 MB OOMs — docs/PERF.md
+            # round 5). Explicit env wins.
+            env.setdefault("MPI4DL_TPU_SCANQ_STORE_MB", "3000")
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=3600,
+            timeout=3600, env=env,
         )
         line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT")]
         if not line:

@@ -177,6 +177,17 @@ def test_scanq_matches_golden():
     test_scan2_nested_remat_matches_golden(remat="scanq")
 
 
+def test_scanq_store_budget_matches_golden(monkeypatch):
+    """MPI4DL_TPU_SCANQ_STORE_MB grants runs the plain stored-carry scan
+    front-to-back until the budget runs out; the rest stay anchored — a
+    storage-placement choice only: numerics must equal the golden step.
+    A 1 MB budget covers depth-44's first stage run (~0.92 MB of f32
+    compact carries) and denies the later two, exercising BOTH paths in
+    one trace."""
+    monkeypatch.setenv("MPI4DL_TPU_SCANQ_STORE_MB", "1")
+    test_scan2_nested_remat_matches_golden(remat="scanq")
+
+
 def test_scan2_offload_matches_golden(monkeypatch):
     """MPI4DL_TPU_SCAN2_OFFLOAD=1 moves scan2's outer chunk boundaries to
     pinned host memory between forward and backward (the ≥4096px HBM
