@@ -57,6 +57,7 @@ AMOEBA_BASELINE = {  # img/s (BASELINE.md chart reads)
 
 _T0 = time.monotonic()
 _RESULT: dict = {}  # latest complete result; emitted incrementally
+_LAST_RUN: dict = {}  # trainer/state/batch of the last successful measurement
 
 
 @functools.lru_cache(maxsize=1)
@@ -281,7 +282,49 @@ def _train_throughput(
         state, metrics = trainer.train_step(state, xs, ys)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
+    # Stash the measured program for the post-headline static analysis
+    # (mpi4dl_tpu.analysis): re-lowering it is a warm-cache no-op.
+    _LAST_RUN.update(trainer=trainer, state=state, xs=xs, ys=ys)
     return batch * steps / dt, trainer.remat
+
+
+def _hlo_overlap_metrics() -> "dict | None":
+    """Static overlap/bytes/peak-HBM metrics of the LAST measured program,
+    recorded into the emitted result line (and thus ``BENCH_*.json``) via
+    the hlolint analyzer. ``BENCH_HLO=0`` disables; failures degrade to an
+    error note — the analysis must never cost a measured headline."""
+    if os.environ.get("BENCH_HLO", "1") == "0" or not _LAST_RUN:
+        return None
+    try:
+        import jax
+
+        from mpi4dl_tpu.analysis import analyze_compiled
+
+        tr = _LAST_RUN["trainer"]
+        compiled = tr._jit_step.lower(
+            _LAST_RUN["state"], _LAST_RUN["xs"], _LAST_RUN["ys"]
+        ).compile()
+        rep = analyze_compiled(
+            compiled,
+            remat=tr.remat_report(),
+            platform=jax.devices()[0].platform,
+        )
+        return {
+            "inventory": {k: v for k, v in rep.inventory.items() if v},
+            "total_collective_bytes": rep.overlap["total_bytes"],
+            "bytes_by_op": rep.overlap["bytes_by_op"],
+            "async_pairs": rep.overlap["async_pairs"],
+            "zero_overlap": len(rep.overlap["zero_overlap"]),
+            "min_compute_between": rep.overlap["min_compute_between"],
+            "peak_hbm_bytes": (
+                rep.memory.get("peak_bytes") if rep.memory else None
+            ),
+            "findings": [
+                f for f in rep.findings if f["severity"] != "info"
+            ],
+        }
+    except Exception as e:  # noqa: BLE001 — advisory metrics only
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
 
 def main():
@@ -411,7 +454,10 @@ def main():
             )
         finally:
             if budget_default:
-                del os.environ["MPI4DL_TPU_SAVE_BUDGET_MB"]
+                # pop, not del: anything inside _train_throughput clearing
+                # the variable must not turn cleanup into a KeyError
+                # (ADVICE r5; matches the scanq cleanup below).
+                os.environ.pop("MPI4DL_TPU_SAVE_BUDGET_MB", None)
         util = mfu(
             ips, train_flops_per_image(cells, size, dtype),
             n_devices=jax.device_count(),
@@ -459,6 +505,10 @@ def main():
                 **entry,
             )
         _emit()  # the driver has its number from this moment on
+        hlo = _hlo_overlap_metrics()
+        if hlo is not None:
+            _RESULT["hlo"] = hlo
+            _emit()
     except Exception as e:  # noqa: BLE001 — extras may still succeed
         headline_error = f"{type(e).__name__}: {str(e)[:200]}"
         # Record in the result dict, not just a comment line: if an
@@ -605,10 +655,15 @@ def main():
                 from mpi4dl_tpu.train import scan_unroll
 
                 # scanq program identity includes its store budget (set
-                # below for the attempt; default 3000).
+                # below for the attempt; default 3000) — but only when
+                # scanq is the policy that actually RUNS FIRST: at 3072
+                # the walk is ["scanlog", "scanq"], and a scanlog
+                # compile-fatal keyed to the scanq budget would be
+                # spuriously invalidated by a later budget-default change,
+                # re-paying scanlog's ~10-minute doomed compile (ADVICE r5).
                 qtag = (
                     "_q" + os.environ.get("MPI4DL_TPU_SCANQ_STORE_MB", "3000")
-                    if "scanq" in walk_remats else ""
+                    if walk_remats[0] == "scanq" else ""
                 )
                 key = (
                     f"resnet110_{size}px_bs1_{'-'.join(walk_remats)}"

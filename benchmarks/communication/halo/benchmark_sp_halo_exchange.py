@@ -56,7 +56,7 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from mpi4dl_tpu.config import tile_grid

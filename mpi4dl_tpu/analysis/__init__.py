@@ -1,0 +1,52 @@
+"""Static analysis over compiled train-step HLO (``hlolint``).
+
+The multi-chip *performance* of this port is unmeasurable on a one-chip
+runtime, but the communication/overlap *structure* of the compiled program
+is statically checkable — and the overlap literature (T3, arXiv:2401.16677;
+FLUX, arXiv:2406.06858) argues the decisive property (is compute scheduled
+between a collective's ``-start`` and ``-done``?) is visible right in the
+scheduled HLO. This package turns ``trainer._jit_step.lower(...).compile()``
+artifacts into:
+
+- a typed op inventory with shapes and bytes-moved per collective
+  (:mod:`mpi4dl_tpu.analysis.inventory`),
+- start→done scheduling distances for async collectives (same module),
+- a rule engine with severities and JSON reports
+  (:mod:`mpi4dl_tpu.analysis.rules`, :mod:`mpi4dl_tpu.analysis.report`),
+- peak-memory extraction + committed-baseline regression checks
+  (:mod:`mpi4dl_tpu.analysis.memory`),
+- a CLI (``python -m mpi4dl_tpu.analyze`` →
+  :mod:`mpi4dl_tpu.analysis.cli`).
+
+Tier-1 tests lint the real compiled CPU-mesh programs with these rules, so
+a stray resharding ``all-to-all``, lost overlap, or a peak-HBM regression
+fails in CI before ever paying a TPU run. See ``docs/ANALYSIS.md``.
+"""
+
+from mpi4dl_tpu.analysis.hlo import (  # noqa: F401
+    HloComputation,
+    HloInstruction,
+    HloModule,
+    parse_hlo_text,
+)
+from mpi4dl_tpu.analysis.inventory import (  # noqa: F401
+    COLLECTIVE_OPS,
+    CollectiveRecord,
+    collective_inventory,
+    collective_records,
+    overlap_summary,
+)
+from mpi4dl_tpu.analysis.memory import memory_summary  # noqa: F401
+from mpi4dl_tpu.analysis.report import (  # noqa: F401
+    Report,
+    analyze_compiled,
+    analyze_hlo_text,
+)
+from mpi4dl_tpu.analysis.rules import (  # noqa: F401
+    DEFAULT_RULES,
+    Expectations,
+    Finding,
+    LintContext,
+    max_severity,
+    run_rules,
+)

@@ -1,0 +1,220 @@
+"""``python -m mpi4dl_tpu.analyze`` — compile a train step, lint its HLO.
+
+Builds the same Trainer the bench/tests use, compiles
+``trainer._jit_step.lower(...).compile()`` (no step is ever executed — on a
+CPU mesh this lints the full distributed program without touching a TPU),
+derives partition-math expectations (tile grid + counted halo shifts), runs
+the rule engine, and writes one JSON report. Exit status is the lint gate:
+nonzero iff findings at/above ``--fail-on`` severity exist.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.analyze --model resnet \
+        --size 512 --json /tmp/r.json
+    python -m mpi4dl_tpu.analyze --model amoebanet --size 64 --dp 2
+    python -m mpi4dl_tpu.analyze --model resnet --size 512 --write-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from mpi4dl_tpu.analysis.rules import SEVERITY_ORDER
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.analyze",
+        description="Static HLO lint over a compiled mpi4dl_tpu train step",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--model", choices=("resnet", "amoebanet"), default="resnet")
+    p.add_argument("--size", type=int, default=512, help="square image size")
+    p.add_argument("--batch", type=int, default=4, help="global batch size")
+    p.add_argument("--depth", type=int, default=8, help="ResNet depth (v1)")
+    p.add_argument(
+        "--layers", type=int, default=6, help="AmoebaNet-D layer count"
+    )
+    p.add_argument(
+        "--filters", type=int, default=64, help="AmoebaNet-D filter count"
+    )
+    p.add_argument(
+        "--spatial-parts", type=int, default=4,
+        help="spatial tiles for the resnet SP front (0 = pure DP)",
+    )
+    p.add_argument(
+        "--spatial-cells", type=int, default=3,
+        help="leading cells that run spatially partitioned (resnet)",
+    )
+    p.add_argument("--slice", default="square", dest="slice_method",
+                   choices=("square", "vertical", "horizontal"))
+    p.add_argument("--dp", type=int, default=0,
+                   help="data-parallel replicas (0 = 1 for spatial, 2 for DP)")
+    p.add_argument(
+        "--remat", default="none",
+        choices=("none", "cell", "sqrt", "scan", "scan2", "scanlog",
+                 "scanq", "scan_save", "cell_save", "group_save"),
+    )
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="write the full report JSON here")
+    p.add_argument("--baseline", default=None,
+                   help="peak-memory baseline file "
+                        "(default docs/artifacts/hlolint_baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record this run's peak memory as the new baseline")
+    p.add_argument("--fail-on", default="error",
+                   choices=("error", "warn", "never"),
+                   help="minimum finding severity that fails the process")
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="relative peak-memory regression tolerance")
+    return p
+
+
+def _build_trainer(args):
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.train import Trainer
+
+    spatial = args.model == "resnet" and args.spatial_parts > 0
+    dp = args.dp or (1 if spatial else 2)
+    remat = False if args.remat == "none" else args.remat
+    if spatial:
+        cfg = ParallelConfig(
+            batch_size=args.batch, split_size=1, spatial_size=1,
+            num_spatial_parts=(args.spatial_parts,),
+            slice_method=args.slice_method,
+            image_size=args.size, data_parallel=dp,
+        )
+    else:
+        cfg = ParallelConfig(
+            batch_size=args.batch, split_size=1, spatial_size=0,
+            image_size=args.size, data_parallel=dp,
+        )
+
+    if args.model == "resnet":
+        from mpi4dl_tpu.models.resnet import get_resnet_v1
+
+        plain = get_resnet_v1(depth=args.depth)
+        n_sp = min(args.spatial_cells, len(plain) - 1) if spatial else 0
+        cells = (
+            get_resnet_v1(depth=args.depth, spatial_cells=n_sp)
+            if n_sp else plain
+        )
+        trainer = Trainer(
+            cells, num_spatial_cells=n_sp, config=cfg, remat=remat,
+            plain_cells=plain if n_sp else None,
+        )
+    else:
+        from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+        cells = amoebanetd(
+            num_classes=10, num_layers=args.layers, num_filters=args.filters
+        )
+        n_sp = 0
+        trainer = Trainer(cells, num_spatial_cells=0, config=cfg, remat=remat)
+    return trainer, cfg, n_sp
+
+
+def _config_key(args, platform: str) -> str:
+    shape = (
+        f"sp{args.spatial_parts}x{args.spatial_cells}_{args.slice_method}"
+        if args.model == "resnet" and args.spatial_parts > 0
+        else f"dp{args.dp or 2}"
+    )
+    arch = (
+        f"d{args.depth}" if args.model == "resnet"
+        else f"l{args.layers}f{args.filters}"
+    )
+    return (
+        f"{args.model}_{arch}_{args.size}px_bs{args.batch}_{shape}"
+        f"_{args.remat}_{platform}"
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from mpi4dl_tpu.utils import apply_platform_env, enable_compilation_cache
+
+    apply_platform_env()
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # The CPU mesh needs virtual devices before backend init (the same
+        # 8-device simulation the test suite runs on).
+        from mpi4dl_tpu.compat import set_cpu_devices
+
+        set_cpu_devices(8)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4dl_tpu.analysis.memory import load_baseline, write_baseline
+    from mpi4dl_tpu.analysis.report import analyze_compiled
+    from mpi4dl_tpu.analysis.rules import Expectations
+
+    platform = jax.devices()[0].platform
+    trainer, cfg, n_sp = _build_trainer(args)
+
+    x_shape = (args.batch, args.size, args.size, 3)
+    state = trainer.init(jax.random.PRNGKey(0), x_shape)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(x_shape), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(args.batch,)), jnp.int32)
+    xs, ys = trainer.shard_batch(x, y)
+    compiled = trainer._jit_step.lower(state, xs, ys).compile()
+
+    if n_sp > 0:
+        halo_shifts = trainer.halo_shift_count(state.params, x_shape)
+        expected = Expectations(
+            tile_shape=cfg.tile_shape, halo_shifts=halo_shifts
+        )
+    else:
+        expected = Expectations(pure_dp=True)
+
+    key = _config_key(args, platform)
+    baseline = load_baseline(key, args.baseline)
+    report = analyze_compiled(
+        compiled,
+        expected=expected,
+        remat=trainer.remat_report(),
+        platform=platform,
+        config={
+            "key": key,
+            "model": args.model,
+            "image_size": args.size,
+            "batch_size": args.batch,
+            "spatial_cells": n_sp,
+            "tile_shape": list(cfg.tile_shape),
+            "data_parallel": cfg.data_parallel,
+            "remat": args.remat,
+            "halo_shifts": expected.halo_shifts,
+        },
+        baseline_bytes=baseline,
+        tolerance=args.tolerance,
+    )
+
+    if args.write_baseline and report.memory:
+        path = write_baseline(key, report.memory["peak_bytes"], args.baseline)
+        print(f"# baseline[{key}] <- {report.memory['peak_bytes']} B ({path})")
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(report.to_json())
+            f.write("\n")
+    print(report.summary_line())
+    for f in report.findings:
+        loc = f" [{f['location']}]" if f.get("location") else ""
+        print(f"  {f['severity'].upper()} {f['rule']}{loc}: {f['message']}")
+
+    if args.fail_on == "never" or report.max_severity is None:
+        return 0
+    if SEVERITY_ORDER[report.max_severity] >= SEVERITY_ORDER[args.fail_on]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via analyze.py
+    sys.exit(main())

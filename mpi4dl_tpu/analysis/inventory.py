@@ -1,0 +1,164 @@
+"""Typed collective inventory + start→done overlap measurement.
+
+Two views over a parsed :class:`~mpi4dl_tpu.analysis.hlo.HloModule`:
+
+- :func:`collective_inventory` — per-class def counts (``all-reduce`` and
+  ``all-reduce-start`` are one class; ``-done`` ops and operand *uses* are
+  never counted). Exactly the semantics the hand-pinned regression test
+  used, now shared.
+- :func:`collective_records` — one record per collective def with
+  bytes-moved and, for async (``-start``/``-done``) pairs in a scheduled
+  module, the schedule distance and how much *compute* XLA actually placed
+  inside the communication window. Zero compute between start and done is
+  the statically-visible signature of lost overlap (T3, arXiv:2401.16677).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from mpi4dl_tpu.analysis.hlo import HloModule, parse_hlo_text
+
+# Collective classes tracked by the inventory (base opcodes; ``-start``
+# variants fold into the base class).
+COLLECTIVE_OPS = (
+    "collective-permute",
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "reduce-scatter",
+    "collective-broadcast",
+    "ragged-all-to-all",
+)
+
+# Opcodes that represent real work for overlap purposes. In optimized HLO
+# nearly all elementwise/dot/conv work lives inside ``fusion`` ops;
+# ``custom-call`` covers Pallas kernels and library calls.
+COMPUTE_OPCODES = frozenset({
+    "fusion", "convolution", "dot", "custom-call", "while", "conditional",
+    "reduce", "reduce-window", "select-and-scatter", "scatter", "sort",
+    "cholesky", "triangular-solve", "fft",
+})
+
+
+def _as_module(hlo) -> HloModule:
+    return hlo if isinstance(hlo, HloModule) else parse_hlo_text(str(hlo))
+
+
+def base_opcode(opcode: str) -> str | None:
+    """Collective class of an opcode: ``all-reduce-start`` → ``all-reduce``;
+    ``-done`` ops and non-collectives → None."""
+    if opcode.endswith("-done"):
+        return None
+    stem = opcode[: -len("-start")] if opcode.endswith("-start") else opcode
+    return stem if stem in COLLECTIVE_OPS else None
+
+
+def collective_inventory(hlo, ops=None) -> dict:
+    """Def count per collective class over the whole module (all
+    computations — fused/while bodies included, like the regex pin the
+    tier-1 inventory test originally hand-rolled)."""
+    module = _as_module(hlo)
+    ops = tuple(ops) if ops is not None else COLLECTIVE_OPS
+    counts = {op: 0 for op in ops}
+    for instr in module.all_instructions():
+        op = base_opcode(instr.opcode)
+        if op in counts:
+            counts[op] += 1
+    return counts
+
+
+@dataclasses.dataclass
+class CollectiveRecord:
+    """One collective def. ``bytes_moved`` is the payload byte size (the
+    done-op result for async pairs, the result shape otherwise — tuple
+    results of sync ops count each element once)."""
+
+    name: str
+    opcode: str  # base class, e.g. "all-reduce"
+    computation: str
+    bytes_moved: int
+    is_async: bool = False
+    done_name: str | None = None
+    # Async pairs only (scheduled modules): instruction count strictly
+    # between start and done, and how many of those are compute ops.
+    distance: int | None = None
+    compute_between: int | None = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def collective_records(hlo) -> list[CollectiveRecord]:
+    module = _as_module(hlo)
+    records: list[CollectiveRecord] = []
+    for comp in module.computations.values():
+        instrs = comp.instructions
+        for instr in instrs:
+            op = base_opcode(instr.opcode)
+            if op is None:
+                continue
+            if instr.opcode.endswith("-start"):
+                done = None
+                for cand in instrs[instr.index + 1 :]:
+                    if (
+                        cand.opcode == op + "-done"
+                        and instr.name in cand.operands
+                    ):
+                        done = cand
+                        break
+                if done is not None:
+                    between = instrs[instr.index + 1 : done.index]
+                    records.append(CollectiveRecord(
+                        name=instr.name,
+                        opcode=op,
+                        computation=comp.name,
+                        bytes_moved=done.shape.byte_size(),
+                        is_async=True,
+                        done_name=done.name,
+                        distance=len(between),
+                        compute_between=sum(
+                            1 for i in between if i.opcode in COMPUTE_OPCODES
+                        ),
+                    ))
+                    continue
+                # Unpaired start (done in another computation / truncated
+                # dump): record as async with unknown distance.
+                records.append(CollectiveRecord(
+                    name=instr.name,
+                    opcode=op,
+                    computation=comp.name,
+                    bytes_moved=instr.shape.byte_size(),
+                    is_async=True,
+                ))
+                continue
+            records.append(CollectiveRecord(
+                name=instr.name,
+                opcode=op,
+                computation=comp.name,
+                bytes_moved=instr.shape.byte_size(),
+            ))
+    return records
+
+
+def overlap_summary(records) -> dict:
+    """Aggregate overlap/bytes metrics for reports and BENCH entries."""
+    bytes_by_op: dict[str, int] = {}
+    for r in records:
+        bytes_by_op[r.opcode] = bytes_by_op.get(r.opcode, 0) + r.bytes_moved
+    async_pairs = [r for r in records if r.is_async and r.distance is not None]
+    zero = [r.name for r in async_pairs if r.compute_between == 0]
+    return {
+        "n_collectives": len(records),
+        "total_bytes": sum(r.bytes_moved for r in records),
+        "bytes_by_op": bytes_by_op,
+        "async_pairs": len(async_pairs),
+        "zero_overlap": zero,
+        "min_compute_between": min(
+            (r.compute_between for r in async_pairs), default=None
+        ),
+        "mean_distance": (
+            sum(r.distance for r in async_pairs) / len(async_pairs)
+            if async_pairs else None
+        ),
+    }

@@ -1,0 +1,83 @@
+"""Peak-memory extraction from compiled executables + committed baselines.
+
+``compiled.memory_analysis()`` reports the buffer-assignment totals on every
+backend of this runtime (CPU included, which is what makes the regression
+gate runnable in CI without a TPU). Peak is taken as
+``argument + output + temp - alias`` — arguments/outputs that alias
+(donated train state) are counted once, matching how the allocator sees the
+program. Baselines are committed JSON (``docs/artifacts/hlolint_baseline.json``)
+keyed by an explicit config string, so a regression is a diff against a
+reviewed number, not against whatever the previous CI run happened to see.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "docs", "artifacts", "hlolint_baseline.json",
+)
+
+
+def memory_summary(compiled) -> dict | None:
+    """Byte totals for a compiled executable, or None when the backend
+    can't report them (the lint then simply skips the memory rule)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend-dependent, absence is fine
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for f in _FIELDS:
+        v = getattr(ma, f, None)
+        if v is not None:
+            out[f.replace("_size_in_bytes", "_bytes")] = int(v)
+    if not out:
+        return None
+    out["peak_bytes"] = (
+        out.get("argument_bytes", 0)
+        + out.get("output_bytes", 0)
+        + out.get("temp_bytes", 0)
+        - out.get("alias_bytes", 0)
+    )
+    return out
+
+
+def load_baseline(key: str, path: str | None = None) -> int | None:
+    path = path or DEFAULT_BASELINE_PATH
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except Exception:  # noqa: BLE001 — absent/corrupt = no baseline
+        return None
+    ent = data.get(key)
+    if isinstance(ent, dict):
+        ent = ent.get("peak_bytes")
+    return int(ent) if ent is not None else None
+
+
+def write_baseline(key: str, peak_bytes: int, path: str | None = None) -> str:
+    """Record/refresh one config's committed peak (sorted, stable diffs)."""
+    path = path or DEFAULT_BASELINE_PATH
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except Exception:  # noqa: BLE001
+        data = {}
+    data[key] = {"peak_bytes": int(peak_bytes)}
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(dict(sorted(data.items())), f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path

@@ -1,0 +1,134 @@
+"""Report assembly: one JSON-serializable record per analyzed program.
+
+:func:`analyze_hlo_text` is the pure-text entry (unit tests, canned
+snippets); :func:`analyze_compiled` adds what only the live executable
+knows (memory totals). Both run the full rule set and embed the findings,
+so one artifact answers "what does this program do on the wire, how much
+does it hold, and is any of that a regression".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from mpi4dl_tpu.analysis.hlo import parse_hlo_text
+from mpi4dl_tpu.analysis.inventory import (
+    collective_inventory,
+    collective_records,
+    overlap_summary,
+)
+from mpi4dl_tpu.analysis.memory import memory_summary
+from mpi4dl_tpu.analysis.rules import (
+    DEFAULT_RULES,
+    Expectations,
+    LintContext,
+    max_severity,
+    run_rules,
+)
+
+
+@dataclasses.dataclass
+class Report:
+    module_name: str
+    is_scheduled: bool
+    platform: str
+    config: dict
+    inventory: dict
+    collectives: list  # CollectiveRecord.as_dict() entries
+    overlap: dict
+    memory: dict | None
+    findings: list  # Finding.as_dict() entries
+    max_severity: str | None
+
+    @property
+    def ok(self) -> bool:
+        return self.max_severity != "error"
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["ok"] = self.ok
+        return d
+
+    def to_json(self, **kw) -> str:
+        kw.setdefault("indent", 2)
+        return json.dumps(self.as_dict(), **kw)
+
+    def summary_line(self) -> str:
+        n_err = sum(1 for f in self.findings if f["severity"] == "error")
+        n_warn = sum(1 for f in self.findings if f["severity"] == "warn")
+        mem = (
+            f", peak {self.memory['peak_bytes'] / 1e6:.1f} MB"
+            if self.memory and self.memory.get("peak_bytes") is not None
+            else ""
+        )
+        return (
+            f"hlolint {self.module_name or '<module>'}: "
+            f"{self.overlap['n_collectives']} collectives "
+            f"({self.overlap['total_bytes'] / 1e6:.2f} MB moved, "
+            f"{self.overlap['async_pairs']} async pairs{mem}) — "
+            f"{n_err} error(s), {n_warn} warning(s)"
+        )
+
+
+def analyze_hlo_text(
+    text: str,
+    expected: Expectations | None = None,
+    memory: dict | None = None,
+    remat: dict | None = None,
+    platform: str = "",
+    config: dict | None = None,
+    rules=DEFAULT_RULES,
+) -> Report:
+    module = parse_hlo_text(text)
+    inventory = collective_inventory(module)
+    records = collective_records(module)
+    ctx = LintContext(
+        module=module,
+        inventory=inventory,
+        records=records,
+        expected=expected or Expectations(),
+        memory=memory,
+        remat=remat,
+        platform=platform,
+    )
+    findings = run_rules(ctx, rules)
+    return Report(
+        module_name=module.name,
+        is_scheduled=module.is_scheduled,
+        platform=platform,
+        config=config or {},
+        inventory=inventory,
+        collectives=[r.as_dict() for r in records],
+        overlap=overlap_summary(records),
+        memory=memory,
+        findings=[f.as_dict() for f in findings],
+        max_severity=max_severity(findings),
+    )
+
+
+def analyze_compiled(
+    compiled,
+    expected: Expectations | None = None,
+    remat: dict | None = None,
+    platform: str = "",
+    config: dict | None = None,
+    baseline_bytes: int | None = None,
+    tolerance: float = 0.05,
+    rules=DEFAULT_RULES,
+) -> Report:
+    """Analyze a live ``.lower(...).compile()`` executable: HLO text rules
+    plus the memory totals (+ committed-baseline comparison when given)."""
+    memory = memory_summary(compiled)
+    if memory is not None and baseline_bytes is not None:
+        memory["baseline_bytes"] = int(baseline_bytes)
+        memory["tolerance"] = tolerance
+    return analyze_hlo_text(
+        compiled.as_text(),
+        expected=expected,
+        memory=memory,
+        remat=remat,
+        platform=platform,
+        config=config,
+        rules=rules,
+    )

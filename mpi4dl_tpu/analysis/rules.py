@@ -1,0 +1,258 @@
+"""Rule engine: severities, findings, and the standing lint rules.
+
+Rules consume a :class:`LintContext` (parsed module + inventory/records +
+partition-math expectations + memory/remat metadata) and emit
+:class:`Finding`\\ s at ``error`` / ``warn`` / ``info`` severity. The tier-1
+lint gate fails on ``error``; ``warn`` is advisory (printed, recorded in the
+JSON report, never fatal by default).
+
+The point of deriving expectations from partition math (tile grid, counted
+halo shifts) instead of hand-pinned op counts: an INTENTIONAL engine change
+moves the derived bound with it, while a regression (doubled per-layer halo
+traffic, a stray resharding) still lands outside. Hand pins remain useful
+as exact-value regression tests — they live in
+``tests/test_collective_inventory.py`` on top of these rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+from mpi4dl_tpu.analysis.hlo import HloModule
+from mpi4dl_tpu.analysis.inventory import CollectiveRecord
+
+SEVERITY_ORDER = {"info": 0, "warn": 1, "error": 2}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str  # "info" | "warn" | "error"
+    message: str
+    location: str | None = None  # instruction or computation name
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Expectations:
+    """Partition-math inputs for the structural rules. ``None`` disables
+    the rule that needs the value (an analyzer run on a bare HLO snippet
+    can still lint overlap without knowing the mesh)."""
+
+    # Tile grid of the spatial stage, e.g. (2, 2); (1, 1) = no tiling.
+    tile_shape: tuple[int, int] | None = None
+    # Counted forward halo shift ppermutes (Trainer.halo_shift_count):
+    # each is one collective-permute; the backward re-runs the transposed
+    # shifts, partially deduped by XLA — hence the [n, 2n] window.
+    halo_shifts: int | None = None
+    # Extra permutes legitimately present beyond halo traffic (pipeline
+    # stage shifts); widens the upper bound only.
+    extra_permutes: int = 0
+    # True when the program is expected to have NO spatial/model sharding
+    # (pure DP): any permute/gather/scatter then means resharding crept in.
+    pure_dp: bool = False
+
+
+@dataclasses.dataclass
+class LintContext:
+    module: HloModule
+    inventory: dict
+    records: Sequence[CollectiveRecord]
+    expected: Expectations = dataclasses.field(default_factory=Expectations)
+    # memory_summary() output (+ "baseline_bytes"/"tolerance" when a
+    # committed baseline exists for this config).
+    memory: dict | None = None
+    # {"policy": str, "store_budget_mb": float, "granted_bytes": int,
+    #  "grants": {run_key: bytes}} — remat/store-budget effectiveness.
+    remat: dict | None = None
+    platform: str = ""
+    # Collectives smaller than this are noise for overlap purposes.
+    overlap_min_bytes: int = 1 << 20
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    doc: str
+    check: Callable[[LintContext], "list[Finding]"]
+
+
+def _rule_stray_all_to_all(ctx: LintContext) -> list[Finding]:
+    out = []
+    for op in ("all-to-all", "ragged-all-to-all"):
+        n = ctx.inventory.get(op, 0)
+        if n:
+            out.append(Finding(
+                "stray-all-to-all", "error",
+                f"{n} {op} op(s) in the compiled step: nothing in the "
+                "SP/DP/LP engine legitimately emits all-to-all — this is "
+                "XLA resharding an activation or gradient whose sharding "
+                "regressed (check in_specs/out_specs and param specs).",
+            ))
+    return out
+
+
+def _rule_stray_resharding(ctx: LintContext) -> list[Finding]:
+    if not ctx.expected.pure_dp:
+        return []
+    out = []
+    for op in ("collective-permute", "all-gather", "reduce-scatter"):
+        n = ctx.inventory.get(op, 0)
+        if n:
+            out.append(Finding(
+                "stray-resharding", "error",
+                f"pure-DP program contains {n} {op} op(s): gradient/metric "
+                "all-reduces are the only expected collectives — input or "
+                "parameter sharding regressed.",
+            ))
+    return out
+
+
+def _rule_halo_permute_count(ctx: LintContext) -> list[Finding]:
+    exp = ctx.expected
+    if exp.halo_shifts is None:
+        return []
+    actual = ctx.inventory.get("collective-permute", 0)
+    lo = exp.halo_shifts
+    hi = 2 * exp.halo_shifts + exp.extra_permutes
+    if lo <= actual <= hi:
+        return []
+    if actual < lo:
+        msg = (
+            f"{actual} collective-permutes but partition math derives "
+            f">= {lo} forward halo shifts: halo exchanges were elided or "
+            "moved off the permute path (Pallas DMA halo? wrong mesh?)."
+        )
+    else:
+        msg = (
+            f"{actual} collective-permutes exceed the derived ceiling {hi} "
+            f"(= 2 x {exp.halo_shifts} fwd shifts"
+            + (f" + {exp.extra_permutes} pipeline permutes" if
+               exp.extra_permutes else "")
+            + "): per-layer halo traffic multiplied (lost XLA fwd/bwd "
+            "dedupe, doubled exchanges, or resharding riding the "
+            "permute class)."
+        )
+    return [Finding("halo-permute-count", "error", msg)]
+
+
+def _rule_zero_overlap(ctx: LintContext) -> list[Finding]:
+    out = []
+    for r in ctx.records:
+        if not r.is_async or r.distance is None:
+            continue
+        if r.compute_between == 0:
+            big = r.bytes_moved >= ctx.overlap_min_bytes
+            out.append(Finding(
+                "zero-overlap-collective",
+                "error" if big else "warn",
+                f"{r.opcode} {r.name} ({r.bytes_moved} B) completes with "
+                "no compute scheduled between -start and -done "
+                f"(distance {r.distance}): the communication window is "
+                "pure exposed latency (T3/FLUX lost-overlap signature).",
+                location=f"{r.computation}::{r.name}",
+            ))
+    return out
+
+
+def _rule_peak_memory(ctx: LintContext) -> list[Finding]:
+    mem = ctx.memory
+    if not mem or mem.get("peak_bytes") is None:
+        return []
+    baseline = mem.get("baseline_bytes")
+    if baseline is None:
+        return [Finding(
+            "peak-memory-regression", "info",
+            f"peak memory {mem['peak_bytes']} B; no committed baseline for "
+            "this config — run the CLI with --write-baseline to pin it.",
+        )]
+    tol = float(mem.get("tolerance", 0.05))
+    peak = mem["peak_bytes"]
+    if peak > baseline * (1 + tol):
+        return [Finding(
+            "peak-memory-regression", "error",
+            f"peak memory {peak} B exceeds committed baseline {baseline} B "
+            f"by more than {tol:.0%}: a remat/layout change grew the live "
+            "set — re-derive the baseline only if the growth is intentional.",
+        )]
+    if peak < baseline * (1 - tol):
+        return [Finding(
+            "peak-memory-regression", "info",
+            f"peak memory {peak} B is >{tol:.0%} BELOW the committed "
+            f"baseline {baseline} B — refresh the baseline to lock in "
+            "the improvement.",
+        )]
+    return []
+
+
+def _rule_remat_effectiveness(ctx: LintContext) -> list[Finding]:
+    rem = ctx.remat
+    if not rem:
+        return []
+    budget_mb = float(rem.get("store_budget_mb") or 0)
+    if budget_mb <= 0:
+        return []
+    granted = int(rem.get("granted_bytes") or 0)
+    budget_bytes = budget_mb * 1e6
+    out = []
+    if granted == 0:
+        out.append(Finding(
+            "remat-effectiveness", "warn",
+            f"store budget {budget_mb:g} MB granted nothing under policy "
+            f"{rem.get('policy')!r}: every run's carry/save set exceeds the "
+            "budget, so the setting only costs planning time — raise it or "
+            "drop it.",
+        ))
+    elif granted > budget_bytes:
+        out.append(Finding(
+            "remat-effectiveness", "error",
+            f"granted stores ({granted} B) exceed the configured budget "
+            f"({int(budget_bytes)} B): the grant accounting is broken — "
+            "live ranges will blow past the planned peak.",
+        ))
+    peak = (ctx.memory or {}).get("peak_bytes")
+    if granted and peak and granted > 0.5 * peak:
+        out.append(Finding(
+            "remat-effectiveness", "warn",
+            f"granted stores ({granted} B) are >50% of peak memory "
+            f"({peak} B): grants dominate the live set, so early-run "
+            "grants stay live through the whole backward (ADVICE r5 "
+            "front-to-back liveness hazard) — prefer granting late runs.",
+        ))
+    return out
+
+
+DEFAULT_RULES: tuple[Rule, ...] = (
+    Rule("stray-all-to-all",
+         "any all-to-all is a resharding bug", _rule_stray_all_to_all),
+    Rule("stray-resharding",
+         "pure-DP programs may only all-reduce", _rule_stray_resharding),
+    Rule("halo-permute-count",
+         "collective-permute count must sit in the partition-math window",
+         _rule_halo_permute_count),
+    Rule("zero-overlap-collective",
+         "async collectives must overlap compute", _rule_zero_overlap),
+    Rule("peak-memory-regression",
+         "peak memory vs committed baseline", _rule_peak_memory),
+    Rule("remat-effectiveness",
+         "store-budget grants vs live ranges", _rule_remat_effectiveness),
+)
+
+
+def run_rules(ctx: LintContext, rules: Sequence[Rule] = DEFAULT_RULES) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: -SEVERITY_ORDER.get(f.severity, 0))
+    return findings
+
+
+def max_severity(findings) -> str | None:
+    best = None
+    for f in findings:
+        if best is None or SEVERITY_ORDER[f.severity] > SEVERITY_ORDER[best]:
+            best = f.severity
+    return best

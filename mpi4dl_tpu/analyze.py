@@ -1,0 +1,12 @@
+"""``python -m mpi4dl_tpu.analyze`` — entry shim for the static HLO linter.
+
+The implementation lives in :mod:`mpi4dl_tpu.analysis.cli`; this module
+exists so the documented invocation stays a flat ``-m`` target.
+"""
+
+import sys
+
+from mpi4dl_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""Version shims over the installed jax.
+
+The package is written against the current jax API surface
+(``jax.shard_map`` with ``check_vma``, ``lax.axis_size``,
+``jax_num_cpu_devices``); runtimes in the field pin older releases where
+those names live elsewhere or don't exist (0.4.x ships ``shard_map`` under
+``jax.experimental`` with ``check_rep``, no ``lax.axis_size``, and CPU
+device-count control only through ``XLA_FLAGS``). Every such seam is
+resolved HERE, once — modules import :func:`shard_map` / :func:`axis_size`
+/ :func:`set_cpu_devices` from this module instead of guessing per call
+site. Nothing here changes semantics on a current jax: when the native
+name exists it is re-exported untouched.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+__all__ = [
+    "axis_size",
+    "distributed_is_initialized",
+    "optimization_barrier",
+    "put_on_device",
+    "put_on_host",
+    "set_cpu_devices",
+    "shard_map",
+]
+
+
+try:  # jax >= 0.6: a public top-level function
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # 0.4.x: experimental module, check_rep instead of check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+if "check_vma" in inspect.signature(_shard_map_impl).parameters:
+    shard_map = _shard_map_impl
+else:
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        """``jax.shard_map`` call shape on the 0.4.x experimental impl
+        (``check_vma`` was named ``check_rep`` there; same meaning)."""
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+try:  # jax >= 0.4.4x
+    from jax.lax import axis_size
+except ImportError:
+    import jax._src.core as _jax_core
+
+    def axis_size(axis_name) -> int:
+        """Static size of a named mesh axis inside ``shard_map`` — on
+        0.4.x ``core.axis_frame(name)`` resolves to the bound int."""
+        return _jax_core.axis_frame(axis_name)
+
+
+def _jax_version() -> tuple:
+    import jax
+
+    return tuple(int(p) for p in jax.__version__.split(".")[:2])
+
+
+if _jax_version() >= (0, 5):
+    from jax.lax import optimization_barrier
+else:
+    import jax as _jax
+    from jax import lax as _lax
+
+    @_jax.custom_vjp
+    def optimization_barrier(x):
+        """0.4.x shipped ``lax.optimization_barrier`` without an AD rule;
+        wrap it so the barrier applies to the forward value AND to the
+        cotangent (what the newer native transpose rule does) — it stays
+        a pure scheduling fence in both passes."""
+        return _lax.optimization_barrier(x)
+
+    def _ob_fwd(x):
+        return _lax.optimization_barrier(x), None
+
+    def _ob_bwd(_, ct):
+        return (_lax.optimization_barrier(ct),)
+
+    optimization_barrier.defvjp(_ob_fwd, _ob_bwd)
+
+
+def _memory_transfers():
+    """(to_host, to_device) single-array transfer fns: ``jax.memory.Space``
+    on current jax, ``TransferToMemoryKind`` (same placement semantics,
+    sharding-preserving) on 0.4.x."""
+    import jax
+
+    space = getattr(getattr(jax, "memory", None), "Space", None)
+    if space is not None:
+        return (
+            lambda a: jax.device_put(a, space.Host),
+            lambda a: jax.device_put(a, space.Device),
+        )
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    return (
+        lambda a: jax.device_put(a, TransferToMemoryKind("pinned_host")),
+        lambda a: jax.device_put(a, TransferToMemoryKind("device")),
+    )
+
+
+def put_on_host(a):
+    return _memory_transfers()[0](a)
+
+
+def put_on_device(a):
+    return _memory_transfers()[1](a)
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` (absent on 0.4.x: probe the
+    global client state instead, same truth)."""
+    import jax
+
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:
+        from jax._src import distributed as _dist
+
+        state = getattr(_dist, "global_state", None)
+        return bool(state is not None and state.client is not None)
+    except Exception:  # noqa: BLE001 — internals moved: assume uninitialized
+        return False
+
+
+def set_cpu_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices, before first backend use.
+
+    New jax has a real config knob; on 0.4.x the only channel is the
+    ``--xla_force_host_platform_device_count`` XLA flag, which is read at
+    backend initialization — so this works only if called before the first
+    ``jax.devices()``-like call (the same contract the config knob has).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n}"
+        if "--xla_force_host_platform_device_count" in flags:
+            import re
+
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags

@@ -147,7 +147,11 @@ class ClassPatternImages:
         return max(self.length // self.batch_size, 1)
 
     def batch(self, i):
-        rng = np.random.default_rng(self.seed * 1_000_003 + i)
+        # SeedSequence over the (seed, batch) pair: genuinely independent
+        # per-pair streams. The old ``seed * 1_000_003 + i`` mix collided
+        # across seeds ((0, 1000003) == (1, 0)) and degenerated to
+        # ``default_rng(i)`` at seed 0 (ADVICE r5).
+        rng = np.random.default_rng(np.random.SeedSequence((self.seed, i)))
         y = rng.integers(0, self.num_classes, size=(self.batch_size,))
         x = self._templates[y] + self.noise * rng.standard_normal(
             (self.batch_size, self.image_size, self.image_size, 3)

@@ -36,6 +36,7 @@ from typing import Any, Sequence
 import jax
 import jax.numpy as jnp
 
+from mpi4dl_tpu.compat import axis_size
 from mpi4dl_tpu.ops.layers import bn_stats_mode
 from mpi4dl_tpu.train import correct_count, cross_entropy_sum
 
@@ -228,7 +229,7 @@ def _spatial_metrics(trainer, logits, y):
 
     from mpi4dl_tpu.config import AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W
 
-    replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
+    replicas = axis_size(AXIS_TILE_H) * axis_size(AXIS_TILE_W)
     axes = (AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W)
     ce = lax.psum(cross_entropy_sum(logits, y) / replicas, axes)
     cc = lax.psum(
@@ -248,7 +249,7 @@ def make_spatial_eval_step(trainer):
     cached = getattr(trainer, "_spatial_eval_step", None)
     if cached is not None:
         return cached
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def local(params, batch_stats, x, y):
@@ -279,7 +280,7 @@ def spatial_collect_batch_stats(trainer, params, batches) -> list:
     :func:`collect_batch_stats` for models whose full-image forward does
     not fit one device. ``batches``: iterable of host input arrays (global
     batch shape, like the training inputs)."""
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def local_first(params, x):

@@ -175,9 +175,13 @@ def _bwd_impl(x, dy, w2, interpret=False):
 
 
 @functools.lru_cache(maxsize=None)
-def _compiles(x_shape, dtype, o) -> bool:
+def _compiles(x_shape, dtype, o, w_dtype) -> bool:
     """Cached on-device compile probe (Mosaic/VMEM-stack failures only
-    surface on real hardware)."""
+    surface on real hardware). The weight dtype is part of the key AND the
+    probed signature: mixed-precision params (f32 weights under bf16
+    activations) compile a DIFFERENT Mosaic program than the homogeneous
+    one, and a probe that passed for x's dtype must not green-light an
+    unprobed path (ADVICE r5)."""
     import warnings
 
     try:
@@ -185,19 +189,21 @@ def _compiles(x_shape, dtype, o) -> bool:
         jax.jit(_bwd_impl).lower(
             jax.ShapeDtypeStruct((b, h, w, c), dtype),
             jax.ShapeDtypeStruct((b, h, w, o), dtype),
-            jax.ShapeDtypeStruct((c, o), dtype),
+            jax.ShapeDtypeStruct((c, o), w_dtype),
         ).compile()
         return True
     except Exception as e:  # noqa: BLE001 — fall back to the two-dot path
         warnings.warn(
             "fused 1x1 backward kernel failed to compile for "
-            f"x={x_shape} O={o}; using the XLA two-dot backward. "
-            f"Error: {str(e)[:400]}"
+            f"x={x_shape} O={o} w_dtype={w_dtype}; using the XLA two-dot "
+            f"backward. Error: {str(e)[:400]}"
         )
         return False
 
 
-def dispatchable(x, dy) -> bool:
+def dispatchable(x, dy, w=None) -> bool:
+    """``w``: the conv weight (any shape; only its dtype matters here).
+    ``None`` keeps the legacy assumption w.dtype == x.dtype."""
     from mpi4dl_tpu.parallel.halo import _is_batch_tracer, _xla_only_active
 
     if dot1x1_mode() == "off":
@@ -212,7 +218,10 @@ def dispatchable(x, dy) -> bool:
         return False
     if not supported(tuple(x.shape), dy.shape[-1], x.dtype.itemsize):
         return False
-    return _compiles(tuple(x.shape), jnp.dtype(x.dtype).name, dy.shape[-1])
+    w_dtype = jnp.dtype(w.dtype if w is not None else x.dtype).name
+    return _compiles(
+        tuple(x.shape), jnp.dtype(x.dtype).name, dy.shape[-1], w_dtype
+    )
 
 
 def bwd_1x1(x, dy, w2, interpret=False):

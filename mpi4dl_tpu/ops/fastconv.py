@@ -247,7 +247,7 @@ def _conv2d_s1_bwd(padding, res, dy):
         # step, docs/PERF.md round 5).
         from mpi4dl_tpu.ops import dot1x1_pallas
 
-        if _on_tpu() and dot1x1_pallas.dispatchable(x, dy):
+        if _on_tpu() and dot1x1_pallas.dispatchable(x, dy, w):
             c, o = x.shape[-1], dy.shape[-1]
             dx, dw = dot1x1_pallas.bwd_1x1(
                 x, dy, w.reshape(c, o)

@@ -73,13 +73,29 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi4dl_tpu.compat import axis_size
+
+
+def interpret_available() -> bool:
+    """Whether this jax can interpret TPU-distributed Pallas kernels on
+    CPU (``InterpretParams``; ``TPUInterpretParams`` on 2024-era lines;
+    absent entirely on 0.4.x — tests skip the pallas halo there)."""
+    return any(
+        hasattr(pltpu, n) for n in ("InterpretParams", "TPUInterpretParams")
+    )
+
 
 def _interpret():
     # Pallas TPU kernels run interpreted on CPU test meshes.
-    return (
-        pltpu.InterpretParams()
-        if jax.default_backend() != "tpu"
-        else False
+    if jax.default_backend() == "tpu":
+        return False
+    for name in ("InterpretParams", "TPUInterpretParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            return cls()
+    raise NotImplementedError(
+        "this jax has no TPU-Pallas CPU interpreter; the pallas halo "
+        "impl needs a real TPU here (use MPI4DL_TPU_HALO_IMPL=xla)"
     )
 
 
@@ -91,7 +107,7 @@ def _swap_kernel(axis_name: str):
 
     def kernel(a_ref, b_ref, ra_ref, rb_ref, send_sem, recv_sem):
         idx = lax.axis_index(axis_name)
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         nxt = lax.rem(idx + 1, n)
         prv = lax.rem(idx - 1 + n, n)
         # MESH-typed device ids address "same coordinates except this axis",
@@ -209,7 +225,7 @@ strip_swap.defvjp(_strip_swap_fwd, _strip_swap_bwd)
 def _axis_exchange(x, halo: int, axis_name: str, array_axis: int, fill_value):
     """One axis of the halo exchange: returns x extended with ``halo``
     rows/cols of neighbor data on both sides of ``array_axis``."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     size = x.shape[array_axis]
     if halo > size:
         raise ValueError(f"halo={halo} exceeds local tile extent {size}")
@@ -236,9 +252,9 @@ def halo_exchange_pallas(
     :func:`mpi4dl_tpu.parallel.halo.halo_exchange` (same contract, same
     two-phase corner composition: W-phase strips of the H-extended tile carry
     the corner halos)."""
-    if halo_h > 0 and lax.axis_size(axis_h) >= 1:
+    if halo_h > 0 and axis_size(axis_h) >= 1:
         x = _axis_exchange(x, halo_h, axis_h, 1, fill_value)
-    if halo_w > 0 and lax.axis_size(axis_w) >= 1:
+    if halo_w > 0 and axis_size(axis_w) >= 1:
         x = _axis_exchange(x, halo_w, axis_w, 2, fill_value)
     return x
 

@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import contextlib
 
-from jax import lax
 import jax.numpy as jnp
+from jax import lax
+
+from mpi4dl_tpu.compat import axis_size
 
 # -- Pallas-impl safety plumbing (see halo_exchange's impl dispatch) ---------
 
@@ -61,9 +63,34 @@ def _is_batch_tracer(x) -> bool:
         return False
 
 
+_SHIFT_COUNTERS: list = []  # stacked boxes armed by count_halo_shifts
+
+
+@contextlib.contextmanager
+def count_halo_shifts():
+    """Count halo shift ppermutes issued while tracing the enclosed region.
+
+    Each :func:`_shift` over an axis of size > 1 lowers to exactly one
+    ``collective-permute``, so the count taken over ONE un-scanned forward
+    pass is the partition-math floor for the compiled program's permute
+    inventory (the backward re-runs the transposed shifts, at most doubling
+    it) — the derivation :mod:`mpi4dl_tpu.analysis.rules` checks against.
+    Yields a one-element list whose [0] is the running count.
+    """
+    box = [0]
+    _SHIFT_COUNTERS.append(box)
+    try:
+        yield box
+    finally:
+        _SHIFT_COUNTERS.remove(box)
+
+
 def _shift(x, axis_name: str, direction: int):
     """ppermute x one step along a mesh axis; missing sources yield zeros."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
+    if n > 1:
+        for box in _SHIFT_COUNTERS:
+            box[0] += 1
     perm = [(i, i + direction) for i in range(n) if 0 <= i + direction < n]
     return lax.ppermute(x, axis_name, perm)
 
@@ -80,9 +107,9 @@ def gather_tiles(x, axis_h: str = "tile_h", axis_w: str = "tile_w"):
     exactly the reference's row-major tile layout (``split_input``,
     ``train_spatial.py:241-290``).
     """
-    if lax.axis_size(axis_h) > 1:
+    if axis_size(axis_h) > 1:
         x = lax.all_gather(x, axis_h, axis=1, tiled=True)
-    if lax.axis_size(axis_w) > 1:
+    if axis_size(axis_w) > 1:
         x = lax.all_gather(x, axis_w, axis=2, tiled=True)
     return x
 
@@ -167,7 +194,7 @@ def halo_exchange(
         from_above = _shift(x[:, h - halo_h :, :, :], axis_h, +1)
         from_below = _shift(x[:, :halo_h, :, :], axis_h, -1)
         from_above = _edge_fill(from_above, axis_h, 0)
-        from_below = _edge_fill(from_below, axis_h, lax.axis_size(axis_h) - 1)
+        from_below = _edge_fill(from_below, axis_h, axis_size(axis_h) - 1)
         x = jnp.concatenate([from_above, x, from_below], axis=1)
     if halo_w > 0:
         if halo_w > w:
@@ -175,7 +202,7 @@ def halo_exchange(
         from_left = _shift(x[:, :, w - halo_w :, :], axis_w, +1)
         from_right = _shift(x[:, :, :halo_w, :], axis_w, -1)
         from_left = _edge_fill(from_left, axis_w, 0)
-        from_right = _edge_fill(from_right, axis_w, lax.axis_size(axis_w) - 1)
+        from_right = _edge_fill(from_right, axis_w, axis_size(axis_w) - 1)
         x = jnp.concatenate([from_left, x, from_right], axis=2)
     return x
 
@@ -202,7 +229,7 @@ def fill_boundary_halo(
     b, h, w, c = x.shape
     if halo_h:
         idx = lax.axis_index(axis_h)
-        n = lax.axis_size(axis_h)
+        n = axis_size(axis_h)
         row = jnp.arange(h)
         outside = ((idx == 0) & (row < halo_h)) | (
             (idx == n - 1) & (row >= h - halo_h)
@@ -210,7 +237,7 @@ def fill_boundary_halo(
         x = jnp.where(outside[None, :, None, None], value, x)
     if halo_w:
         idx = lax.axis_index(axis_w)
-        n = lax.axis_size(axis_w)
+        n = axis_size(axis_w)
         col = jnp.arange(w)
         outside = ((idx == 0) & (col < halo_w)) | (
             (idx == n - 1) & (col >= w - halo_w)

@@ -109,7 +109,9 @@ def initialize_distributed(
     pod launch into N independent single-host jobs is the one outcome this
     wrapper must never produce.
     """
-    if jax.distributed.is_initialized():
+    from mpi4dl_tpu.compat import distributed_is_initialized
+
+    if distributed_is_initialized():
         return
     configured = (
         coordinator_address is not None

@@ -57,9 +57,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from mpi4dl_tpu.compat import axis_size, shard_map
 from mpi4dl_tpu.config import (
     AXIS_DATA,
     AXIS_PIPE,
@@ -479,8 +479,8 @@ class PipelineTrainer:
         if self.local_dp > 1:
             replicas = 1
         else:
-            replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
-        denom = n_examples_local * lax.axis_size(AXIS_DATA) * replicas
+            replicas = axis_size(AXIS_TILE_H) * axis_size(AXIS_TILE_W)
+        denom = n_examples_local * axis_size(AXIS_DATA) * replicas
         axes = (AXIS_DATA, AXIS_PIPE, AXIS_TILE_H, AXIS_TILE_W)
         return lax.psum(ce / denom, axes), lax.psum(cc / denom, axes)
 
@@ -492,7 +492,7 @@ class PipelineTrainer:
         with the scatter replaced by slicing the already-joined tensor)."""
         if self.local_dp <= 1:
             return front_out, y
-        tw = lax.axis_size(AXIS_TILE_W)
+        tw = axis_size(AXIS_TILE_W)
         idx = lax.axis_index(AXIS_TILE_H) * tw + lax.axis_index(AXIS_TILE_W)
         k = self.mb_back
 

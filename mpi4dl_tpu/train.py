@@ -34,9 +34,9 @@ import numpy as np
 import optax
 from flax import struct
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from mpi4dl_tpu.compat import axis_size, optimization_barrier, shard_map
 from mpi4dl_tpu.config import (
     AXIS_DATA,
     AXIS_PIPE,
@@ -118,14 +118,14 @@ def chain_quadratic(apply_fn, stacked, x0):
                 )
                 # Serialize the sweep so XLA holds ONE rolling value, not
                 # several cells' temps (the scan2/scanlog discipline).
-                return lax.optimization_barrier(h2), None
+                return optimization_barrier(h2), None
 
             hk, _ = lax.scan(rec_body, x0, (idx, ps))
             pk = jax.tree.map(lambda a: a[k], ps)
             _, cell_vjp = jax.vjp(apply_fn, pk, hk)
             dp_k, d_h = cell_vjp(d_h)
             dps = jax.tree.map(lambda acc, g: acc.at[k].add(g), dps, dp_k)
-            return lax.optimization_barrier((d_h, dps))
+            return optimization_barrier((d_h, dps))
 
         zeros = jax.tree.map(jnp.zeros_like, ps)
         d_h, dps = lax.fori_loop(0, n, outer, (dy, zeros))
@@ -562,7 +562,7 @@ class Trainer:
                 if i == self.n_spatial and self.n_spatial > 0:
                     h = jax.tree.map(gather_tiles, h)
                 h = ckpt(self.cells[i].apply)(params[i], h)
-                h = lax.optimization_barrier(h)
+                h = optimization_barrier(h)
                 continue
             if run[0] == self.n_spatial and self.n_spatial > 0:
                 h = jax.tree.map(gather_tiles, h)
@@ -602,7 +602,7 @@ class Trainer:
                 # Short runs stay on the plain checkpointed scan: the
                 # masked-sweep machinery only pays past ~2 cells.
                 hc = chain_quadratic(apply_compact, stacked, hc)
-                hc = lax.optimization_barrier(hc)
+                hc = optimization_barrier(hc)
             elif (
                 self.remat == "scan2"
                 and len(run) >= 4
@@ -639,6 +639,7 @@ class Trainer:
             self._scanq_budget_key = self._scan_plan_key
             self._scanq_budget_left = budget_mb * 1e6
             self._scanq_grants = {}
+            self._scanq_grant_bytes = {}
         if key not in self._scanq_grants:
             carry_bytes = sum(
                 int(np.prod(a.shape)) * a.dtype.itemsize
@@ -647,6 +648,9 @@ class Trainer:
             granted = carry_bytes <= self._scanq_budget_left
             if granted:
                 self._scanq_budget_left -= carry_bytes
+                # Recorded per run for the analyzer's remat-effectiveness
+                # rule (Trainer.remat_report): grants vs budget vs peak.
+                self._scanq_grant_bytes[key] = carry_bytes
             self._scanq_grants[key] = granted
         return self._scanq_grants[key]
 
@@ -683,14 +687,14 @@ class Trainer:
                 h = jax.checkpoint(functools.partial(self._run_cell, i))(
                     ps[0], h
                 )
-                return lax.optimization_barrier(h)
+                return optimization_barrier(h)
             mid = (i + j) // 2
 
             def left(ps_left, h):
                 return rec(i, mid, ps_left, h)
 
             h = jax.checkpoint(left)(ps[: mid - i], h)
-            h = lax.optimization_barrier(h)
+            h = optimization_barrier(h)
             return rec(mid, j, ps[mid - i :], h)
 
         return rec(0, len(self.cells), list(params), x)
@@ -723,7 +727,7 @@ class Trainer:
                 # (docs/PERF.md round 4). Inner unroll stays 1 for the
                 # same reason (MPI4DL_TPU_SCAN2_UNROLL overrides).
                 hc = jax.checkpoint(apply_compact)(p, hc)
-                return lax.optimization_barrier(hc), None
+                return optimization_barrier(hc), None
 
             inner_unroll = int(os.environ.get("MPI4DL_TPU_SCAN2_UNROLL", "1"))
             hc, _ = lax.scan(body, hc, ps, unroll=inner_unroll)
@@ -739,18 +743,17 @@ class Trainer:
             # program's entry/exit trip the XLA offloader ("moved to host
             # ... returned from the entry computation"), and the
             # optimization barriers around each transfer stop placement
-            # propagation into neighboring fusions; jax.memory.Space
-            # transfers preserve the traced sharding, so the path is
-            # mesh-shape-agnostic. (A single outer
+            # propagation into neighboring fusions; memory-space transfers
+            # (compat.put_on_host/put_on_device) preserve the traced
+            # sharding, so the path is mesh-shape-agnostic. (A single outer
             # checkpoint with a save_and_offload policy was measured
             # WORSE — one big recompute region overlaps chunks'
             # backwards, docs/PERF.md round 4.)
             def chunk_off(hc_host, ps):
-                hc = jax.tree.map(
-                    lambda a: jax.device_put(a, jax.memory.Space.Device),
-                    hc_host,
-                )
-                hc = lax.optimization_barrier(hc)
+                from mpi4dl_tpu.compat import put_on_device
+
+                hc = jax.tree.map(put_on_device, hc_host)
+                hc = optimization_barrier(hc)
                 return chunk(hc, ps)
 
             chunk_off_ck = jax.checkpoint(chunk_off)
@@ -762,11 +765,10 @@ class Trainer:
                 ps = jax.tree.map(lambda a: a[lo:hi], stacked)
                 interior = 0 < i < len(bounds) - 2
                 if interior:
-                    hc = lax.optimization_barrier(hc)
-                    hc_host = jax.tree.map(
-                        lambda a: jax.device_put(a, jax.memory.Space.Host),
-                        hc,
-                    )
+                    from mpi4dl_tpu.compat import put_on_host
+
+                    hc = optimization_barrier(hc)
+                    hc_host = jax.tree.map(put_on_host, hc)
                     hc = chunk_off_ck(hc_host, ps)
                 else:
                     hc = chunk_ck_plain(hc, ps)
@@ -834,7 +836,7 @@ class Trainer:
                         return h
 
                     h = save_ckpt(run_group)([params[i] for i in idx], h)
-                    h = lax.optimization_barrier(h)
+                    h = optimization_barrier(h)
             return h
         h = x
         for i in range(len(self.cells)):
@@ -853,8 +855,8 @@ class Trainer:
         """
         logits = self._apply_cells_remat(params, x)
 
-        d = lax.axis_size(AXIS_DATA)
-        replicas = lax.axis_size(AXIS_TILE_H) * lax.axis_size(AXIS_TILE_W)
+        d = axis_size(AXIS_DATA)
+        replicas = axis_size(AXIS_TILE_H) * axis_size(AXIS_TILE_W)
         global_b = y.shape[0] * d
         denom = global_b * replicas
         axes = (AXIS_DATA, AXIS_TILE_H, AXIS_TILE_W)
@@ -950,6 +952,49 @@ class Trainer:
         from mpi4dl_tpu.parallel.multihost import put_global
 
         return put_global(self.mesh, (self.x_spec, self.y_spec), x, y)
+
+    # -- static analysis support (mpi4dl_tpu.analysis) -----------------------
+    def halo_shift_count(self, params, x_shape, dtype=jnp.float32) -> int:
+        """Forward halo shift ppermutes in ONE un-scanned pass over the
+        cells — the partition-math floor the analyzer's permute rule checks
+        the compiled inventory against (each shift lowers to exactly one
+        ``collective-permute``; the backward at most doubles it). Counted
+        by abstract tracing (``jax.eval_shape``) with the per-cell loop
+        shared by every remat policy, so scan-carried cells are counted
+        once per ITERATION, not once per compiled body."""
+        from mpi4dl_tpu.parallel.halo import count_halo_shifts
+
+        def local(ps, x):
+            h = x
+            for i in range(len(self.cells)):
+                h = self._run_cell(i, ps[i], h)
+            return jax.tree.map(lambda a: jnp.sum(a, dtype=jnp.float32), h)
+
+        fn = shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(), self.x_spec),
+            out_specs=P(),
+            check_vma=False,
+        )
+        x = jax.ShapeDtypeStruct(tuple(x_shape), dtype)
+        with count_halo_shifts() as box:
+            jax.eval_shape(fn, params, x)
+        return box[0]
+
+    def remat_report(self) -> dict:
+        """Remat/store-budget metadata for the analyzer's effectiveness
+        rule: the configured policy + scanq store budget, and the grant
+        bytes actually recorded at the last trace (empty before tracing)."""
+        grants = getattr(self, "_scanq_grant_bytes", {})
+        return {
+            "policy": self.remat if isinstance(self.remat, str) else str(self.remat),
+            "store_budget_mb": float(
+                os.environ.get("MPI4DL_TPU_SCANQ_STORE_MB", "0")
+            ),
+            "granted_bytes": sum(grants.values()),
+            "grants": dict(grants),
+        }
 
     def train_step(self, state: TrainState, x, y):
         from contextlib import ExitStack

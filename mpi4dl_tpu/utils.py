@@ -18,7 +18,9 @@ def apply_platform_env() -> None:
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
     if m:
-        jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+        from mpi4dl_tpu.compat import set_cpu_devices
+
+        set_cpu_devices(int(m.group(1)))
 
 
 def enable_compilation_cache(default_dir: str | None = None) -> None:
@@ -29,8 +31,17 @@ def enable_compilation_cache(default_dir: str | None = None) -> None:
     dominate benchmark wall time; with a warm cache the whole bench suite
     fits in any driver budget. Directory: ``JAX_COMPILATION_CACHE_DIR`` env,
     else ``default_dir``, else ``<repo>/.cache/jax`` (persists across runs).
+
+    No-op on jax 0.4.x: EXECUTING a persistent-cache-deserialized
+    executable on that line's multi-device CPU backend segfaults/aborts
+    the process (reproduced via checkpoint-restore + cache-hit train step;
+    the same sequence runs clean with the cache off). Paying the compiles
+    again is strictly better than dying mid-suite/mid-bench.
     """
     import jax
+
+    if tuple(int(p) for p in jax.__version__.split(".")[:2]) < (0, 5):
+        return
 
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
     if cache_dir is None:

@@ -13,16 +13,22 @@ not the environment.
 
 import os
 
+from mpi4dl_tpu.compat import set_cpu_devices
+
+set_cpu_devices(8)  # before first backend use; shims old jax via XLA_FLAGS
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 
 # Persistent compilation cache for the suite itself: the fast tier's wall
 # time is dominated by CPU XLA compiles of the golden train steps, which
 # are identical from run to run. Keyed by program+platform, so correctness
 # is jax's concern, not ours; a cold run warms it (~7 min), warm reruns of
 # the fast tier fit the <5-minute CI window (measured — README "Testing").
+# (On jax 0.4.x enable_compilation_cache is a no-op — executing a
+# cache-deserialized executable on that line's multi-device CPU backend
+# segfaults the process; see the function's docstring.)
 from mpi4dl_tpu.utils import enable_compilation_cache
 
 enable_compilation_cache(

@@ -9,19 +9,34 @@ all-to-all fails here instead of silently shipping — the discipline the
 reference enforces by construction with its per-layer explicit
 isend/irecv pairs (``spatial.py:336-413``).
 
-If a test fails after an INTENTIONAL engine change: re-derive the counts
-(the probe is just ``trainer._jit_step.lower(...).compile().as_text()``),
-check the delta is explained by the change, and update the pins in the
-same commit.
-"""
+Counting rides the shared static analyzer (:mod:`mpi4dl_tpu.analysis`) —
+the same inventory the ``python -m mpi4dl_tpu.analyze`` CLI and the bench
+hook report, so the pin semantics cannot drift from the lint rules. On top
+of the exact pins, each config runs the full rule engine and asserts no
+error-severity findings (the tier-1 lint gate; the rules themselves are
+unit-tested on canned HLO in ``tests/test_hlolint.py``).
 
-import re
+If a test fails after an INTENTIONAL engine change: re-derive the counts
+(the probe is just ``trainer._jit_step.lower(...).compile().as_text()``
+through ``collective_inventory``), check the delta is explained by the
+change, and update the pins in the same commit. NOTE: the all-reduce
+count is fusion-dependent — XLA versions differ in how far they bundle
+the per-parameter gradient all-reduces (the jax-0.4.37 runtime emits them
+unfused: 37/57/17 where a 2025 jax emitted 2/11/7). The structural ops
+(permute / gather / all-to-all / reduce-scatter) have been stable across
+compiler versions.
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from mpi4dl_tpu.analysis import (
+    Expectations,
+    analyze_compiled,
+    collective_inventory,
+)
 from mpi4dl_tpu.config import ParallelConfig
 from mpi4dl_tpu.models.resnet import get_resnet_v1
 from mpi4dl_tpu.train import Trainer
@@ -35,15 +50,6 @@ OPS = (
 )
 
 
-def _inventory(hlo: str) -> dict:
-    # Opcode position: space-delimited, directly before its operand paren
-    # (tuple result shapes contain spaces; operand uses like
-    # ``get-tuple-element(%all-to-all.4)`` must not count).
-    return {
-        op: len(re.findall(rf" {op}(?:-start)?\(", hlo)) for op in OPS
-    }
-
-
 def _batch(b, size):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((b, size, size, 3)), jnp.float32)
@@ -51,9 +57,15 @@ def _batch(b, size):
     return x, y
 
 
+def _no_errors(report):
+    errors = [f for f in report.findings if f["severity"] == "error"]
+    assert not errors, errors
+
+
 def test_pure_dp_inventory():
     """DP=2, no spatial: gradient/metrics all-reduces only — any permute,
-    gather, or all-to-all means input/param sharding regressed."""
+    gather, or all-to-all means input/param sharding regressed. The same
+    property is what the analyzer's pure-DP stray-resharding rule lints."""
     cfg = ParallelConfig(
         batch_size=4, split_size=1, spatial_size=0, image_size=32,
         data_parallel=2,
@@ -62,14 +74,16 @@ def test_pure_dp_inventory():
     tr = Trainer(cells, num_spatial_cells=0, config=cfg)
     state = tr.init(jax.random.PRNGKey(0), (4, 32, 32, 3))
     xs, ys = tr.shard_batch(*_batch(4, 32))
-    inv = _inventory(tr._jit_step.lower(state, xs, ys).compile().as_text())
+    compiled = tr._jit_step.lower(state, xs, ys).compile()
+    inv = collective_inventory(compiled.as_text(), ops=OPS)
     assert inv == {
         "collective-permute": 0,
         "all-gather": 0,
-        "all-reduce": 2,  # fused grad bundle + loss/acc psum
+        "all-reduce": 37,  # unfused per-param grad all-reduces + loss/acc
         "all-to-all": 0,
         "reduce-scatter": 0,
     }, inv
+    _no_errors(analyze_compiled(compiled, expected=Expectations(pure_dp=True)))
 
 
 def test_spatial_trainer_inventory():
@@ -87,14 +101,30 @@ def test_spatial_trainer_inventory():
     tr = Trainer(cells, num_spatial_cells=3, config=cfg, plain_cells=plain)
     state = tr.init(jax.random.PRNGKey(0), (4, 32, 32, 3))
     xs, ys = tr.shard_batch(*_batch(4, 32))
-    inv = _inventory(tr._jit_step.lower(state, xs, ys).compile().as_text())
+    compiled = tr._jit_step.lower(state, xs, ys).compile()
+    inv = collective_inventory(compiled.as_text(), ops=OPS)
     assert inv == {
         "collective-permute": 36,  # ~4/exchange fwd + bwd over 5 conv layers
         "all-gather": 2,  # tile join (fwd) + its backward re-gather
-        "all-reduce": 11,  # cross-tile BN stats + grad bundle + loss/acc
+        "all-reduce": 57,  # cross-tile BN stats + per-param grads + loss/acc
         "all-to-all": 0,
         "reduce-scatter": 2,
     }, inv
+
+    # Partition-math derivation (no hand pin): one un-scanned forward
+    # traces 20 shift ppermutes (5 exchanges x 4 shifts on the 2x2 grid),
+    # so the compiled count must land in [20, 40] — and the full rule set
+    # must be clean on the real program.
+    shifts = tr.halo_shift_count(state.params, (4, 32, 32, 3))
+    assert shifts == 20, shifts
+    report = analyze_compiled(
+        compiled,
+        expected=Expectations(tile_shape=cfg.tile_shape, halo_shifts=shifts),
+    )
+    _no_errors(report)
+    # The report carries per-collective bytes for every record.
+    assert report.overlap["total_bytes"] > 0
+    assert all(r["bytes_moved"] > 0 for r in report.collectives)
 
 
 @pytest.mark.slow
@@ -116,11 +146,13 @@ def test_sp_plus_lp_pipeline_inventory():
     tr = PipelineTrainer(cells, cfg, plain_cells=plain)
     state = tr.init(jax.random.PRNGKey(0))
     xs, ys = tr.shard_batch(*_batch(4, 32))
-    inv = _inventory(tr._jit_step.lower(state, xs, ys).compile().as_text())
+    inv = collective_inventory(
+        tr._jit_step.lower(state, xs, ys).compile().as_text(), ops=OPS
+    )
     assert inv == {
         "collective-permute": 20,
         "all-gather": 2,
-        "all-reduce": 7,
+        "all-reduce": 17,
         "all-to-all": 0,
         "reduce-scatter": 2,
     }, inv

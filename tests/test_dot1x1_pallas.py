@@ -45,7 +45,9 @@ def test_conv2d_grad_with_fused_kernel_matches_stock(monkeypatch):
     on (interpreter): gradients must match the stock two-dot backward."""
     from mpi4dl_tpu.ops import fastconv
 
-    monkeypatch.setattr(dot1x1_pallas, "dispatchable", lambda x, dy: True)
+    monkeypatch.setattr(
+        dot1x1_pallas, "dispatchable", lambda x, dy, w=None: True
+    )
     monkeypatch.setattr(
         dot1x1_pallas, "bwd_1x1",
         lambda x, dy, w2: dot1x1_pallas._bwd_impl(x, dy, w2, interpret=True),
@@ -61,12 +63,42 @@ def test_conv2d_grad_with_fused_kernel_matches_stock(monkeypatch):
 
     gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
 
-    monkeypatch.setattr(dot1x1_pallas, "dispatchable", lambda x, dy: False)
+    monkeypatch.setattr(
+        dot1x1_pallas, "dispatchable", lambda x, dy, w=None: False
+    )
     gx0, gw0 = jax.grad(loss, argnums=(0, 1))(x, w)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(gx0), rtol=1e-4)
     np.testing.assert_allclose(
         np.asarray(gw), np.asarray(gw0), rtol=1e-4, atol=1e-4
     )
+
+
+def test_probe_key_includes_weight_dtype(monkeypatch):
+    """Mixed-precision params must reach the compile probe as their own
+    dtype: a probe passed for x's dtype must not green-light an unprobed
+    Mosaic program (ADVICE r5)."""
+    import jax as jax_mod
+
+    probed = []
+    monkeypatch.setenv("MPI4DL_TPU_DOT1X1", "auto")
+    monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        dot1x1_pallas, "_compiles",
+        lambda x_shape, dtype, o, w_dtype: probed.append(
+            (x_shape, dtype, o, w_dtype)
+        ) or True,
+    )
+    x = jnp.zeros((2, 64, 64, 208), jnp.float32)
+    dy = jnp.zeros((2, 64, 64, 208), jnp.float32)
+    w32 = jnp.zeros((208, 208), jnp.float32)
+    w16 = jnp.zeros((208, 208), jnp.bfloat16)
+    assert dot1x1_pallas.dispatchable(x, dy, w32)
+    assert dot1x1_pallas.dispatchable(x, dy, w16)
+    assert probed[0][3] == "float32"
+    assert probed[1][3] == "bfloat16"  # distinct probe, not a cache hit
+    # Legacy call shape (no weight) keeps assuming w.dtype == x.dtype.
+    assert dot1x1_pallas.dispatchable(x, dy)
+    assert probed[2][3] == "float32"
 
 
 def test_plan_respects_vmem_budget():

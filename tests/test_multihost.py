@@ -60,7 +60,12 @@ def test_initialize_distributed_swallows_only_unconfigured(monkeypatch):
 
 
 def test_initialize_distributed_noop_when_initialized(monkeypatch):
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    # raising=False: jax 0.4.x has no is_initialized; the compat probe
+    # (mpi4dl_tpu.compat.distributed_is_initialized) prefers the
+    # attribute whenever it exists, so the monkeypatch works on any jax.
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: True, raising=False
+    )
 
     def boom(*a, **k):  # must not be reached
         raise AssertionError("initialize called despite is_initialized()")

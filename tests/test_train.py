@@ -485,7 +485,7 @@ def test_dp_times_grad_accum_matches_unchunked_dp():
     input (x and y — 2 total), and the unchunked step has none. A change
     that doubles the resharding traffic fails here. Measured cost note in
     docs/PERF.md round 5."""
-    from test_collective_inventory import _inventory
+    from mpi4dl_tpu.analysis import collective_inventory as _inventory
 
     def build():
         return [
