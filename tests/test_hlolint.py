@@ -1,0 +1,248 @@
+"""Unit tests for the static HLO analyzer (:mod:`mpi4dl_tpu.analysis`) on
+canned HLO snippets — parser, inventory, bytes-moved, start→done overlap
+distance, and every lint rule — plus the analyzer CLI on a real (tiny)
+compiled program. None of the canned tests compile a model; they are the
+cheap tier-1 tripwire the ISSUE's acceptance criteria require: a synthetic
+HLO with an unoverlapped collective or a stray all-to-all MUST produce
+error-severity findings."""
+
+import json
+
+import pytest
+
+from mpi4dl_tpu.analysis import (
+    Expectations,
+    analyze_hlo_text,
+    collective_inventory,
+    collective_records,
+    max_severity,
+    overlap_summary,
+    parse_hlo_text,
+)
+from mpi4dl_tpu.analysis.hlo import parse_shape
+from mpi4dl_tpu.analysis.rules import LintContext, run_rules
+
+# A scheduled module with one async (start/done) all-reduce whose window
+# contains real compute (a fusion and a convolution), one sync
+# collective-permute, and operand USES that must not be counted as defs.
+OVERLAPPED = """\
+HloModule overlapped, is_scheduled=true
+
+%fused_computation (param_0.1: f32[8,128]) -> f32[8,128] {
+  %param_0.1 = f32[8,128]{1,0} parameter(0)
+  ROOT %mul.1 = f32[8,128]{1,0} multiply(f32[8,128]{1,0} %param_0.1, f32[8,128]{1,0} %param_0.1)
+}
+
+ENTRY %main.1 (p0: f32[8,128], p1: f32[2,16,16,4]) -> f32[8,128] {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %p1 = f32[2,16,16,4]{3,2,1,0} parameter(1)
+  %ar-start.1 = f32[8,128]{1,0} all-reduce-start(f32[8,128]{1,0} %p0), channel_id=1, replica_groups={{0,1}}, to_apply=%add
+  %fusion.1 = f32[8,128]{1,0} fusion(f32[8,128]{1,0} %p0), kind=kLoop, calls=%fused_computation
+  %convolution.1 = f32[2,16,16,4]{3,2,1,0} convolution(f32[2,16,16,4]{3,2,1,0} %p1, f32[2,16,16,4]{3,2,1,0} %p1), window={size=1x1}, dim_labels=b01f_01io->b01f
+  %cp.1 = f32[8,128]{1,0} collective-permute(f32[8,128]{1,0} %fusion.1), channel_id=2, source_target_pairs={{0,1},{1,0}}
+  ROOT %ar-done.1 = f32[8,128]{1,0} all-reduce-done(f32[8,128]{1,0} %ar-start.1)
+}
+"""
+
+# Same module but with the all-reduce window empty (start immediately
+# followed by done) and a payload over the 1 MiB noise threshold: the
+# statically-visible lost-overlap signature. Also carries a stray
+# all-to-all.
+BAD = """\
+HloModule bad, is_scheduled=true
+
+ENTRY %main.1 (p0: f32[512,1024]) -> f32[512,1024] {
+  %p0 = f32[512,1024]{1,0} parameter(0)
+  %ar-start.1 = f32[512,1024]{1,0} all-reduce-start(f32[512,1024]{1,0} %p0), channel_id=1, replica_groups={{0,1}}, to_apply=%add
+  %ar-done.1 = f32[512,1024]{1,0} all-reduce-done(f32[512,1024]{1,0} %ar-start.1)
+  ROOT %a2a.1 = f32[512,1024]{1,0} all-to-all(f32[512,1024]{1,0} %ar-done.1), channel_id=2, replica_groups={{0,1}}, dimensions={0}
+}
+"""
+
+
+def test_parse_shapes():
+    s, rest = parse_shape("f32[4,16,8,32]{3,2,0,1} all-gather(...)")
+    assert s.dtype == "f32" and s.dims == (4, 16, 8, 32)
+    assert s.byte_size() == 4 * 16 * 8 * 32 * 4
+    assert rest.lstrip().startswith("all-gather")
+    t, _ = parse_shape("(f32[8,128]{1,0}, u32[2]{0}, pred[])")
+    assert t.is_tuple and len(t.elements) == 3
+    assert t.byte_size() == 8 * 128 * 4 + 2 * 4 + 1
+    scalar, _ = parse_shape("bf16[] add(...)")
+    assert scalar.dims == () and scalar.byte_size() == 2
+
+
+def test_parser_structure():
+    mod = parse_hlo_text(OVERLAPPED)
+    assert mod.name == "overlapped" and mod.is_scheduled
+    assert set(mod.computations) == {"fused_computation", "main.1"}
+    assert mod.entry.name == "main.1"
+    ops = [i.opcode for i in mod.entry]
+    assert ops == [
+        "parameter", "parameter", "all-reduce-start", "fusion",
+        "convolution", "collective-permute", "all-reduce-done",
+    ]
+    done = mod.entry.instructions[-1]
+    assert done.is_root and done.operands == ("ar-start.1",)
+    assert mod.entry.instructions[2].channel_id == 1
+
+
+def test_inventory_counts_defs_not_uses():
+    inv = collective_inventory(OVERLAPPED)
+    # start+done is ONE all-reduce; the done's operand use of %ar-start.1
+    # and the permute's operand use of %fusion.1 count nothing.
+    assert inv["all-reduce"] == 1
+    assert inv["collective-permute"] == 1
+    assert inv["all-to-all"] == 0
+
+
+def test_overlap_distance_and_bytes():
+    recs = collective_records(OVERLAPPED)
+    ar = next(r for r in recs if r.opcode == "all-reduce")
+    assert ar.is_async and ar.done_name == "ar-done.1"
+    # fusion, convolution, collective-permute sit between start and done.
+    assert ar.distance == 3
+    assert ar.compute_between == 2  # fusion + convolution; permute is comms
+    assert ar.bytes_moved == 8 * 128 * 4
+    cp = next(r for r in recs if r.opcode == "collective-permute")
+    assert not cp.is_async and cp.distance is None
+    summary = overlap_summary(recs)
+    assert summary["async_pairs"] == 1
+    assert summary["zero_overlap"] == []
+    assert summary["bytes_by_op"]["all-reduce"] == 4096
+
+
+def test_clean_module_lints_clean():
+    report = analyze_hlo_text(OVERLAPPED)
+    assert report.max_severity is None and report.ok
+
+
+def test_zero_overlap_and_stray_all_to_all_fail_the_lint():
+    """The ISSUE acceptance criterion: synthetic HLO with an unoverlapped
+    collective or a stray all-to-all must produce error findings."""
+    report = analyze_hlo_text(BAD)
+    rules_hit = {f["rule"] for f in report.findings if f["severity"] == "error"}
+    assert "zero-overlap-collective" in rules_hit
+    assert "stray-all-to-all" in rules_hit
+    assert not report.ok and report.max_severity == "error"
+
+
+def test_zero_overlap_below_noise_threshold_is_warn():
+    small = BAD.replace("512,1024", "8,16").replace(
+        "ROOT %a2a.1 = f32[8,16]{1,0} all-to-all(f32[8,16]{1,0} %ar-done.1), channel_id=2, replica_groups={{0,1}}, dimensions={0}",
+        "ROOT %n.1 = f32[8,16]{1,0} negate(f32[8,16]{1,0} %ar-done.1)",
+    )
+    report = analyze_hlo_text(small)
+    zo = [f for f in report.findings if f["rule"] == "zero-overlap-collective"]
+    assert zo and all(f["severity"] == "warn" for f in zo)
+
+
+def test_pure_dp_rule_flags_resharding():
+    report = analyze_hlo_text(OVERLAPPED, expected=Expectations(pure_dp=True))
+    assert any(
+        f["rule"] == "stray-resharding" and f["severity"] == "error"
+        for f in report.findings
+    )  # the collective-permute is illegal in a pure-DP program
+
+
+def test_halo_permute_window():
+    # OVERLAPPED has exactly 1 collective-permute.
+    ok = analyze_hlo_text(OVERLAPPED, expected=Expectations(halo_shifts=1))
+    assert not any(f["rule"] == "halo-permute-count" for f in ok.findings)
+    low = analyze_hlo_text(OVERLAPPED, expected=Expectations(halo_shifts=4))
+    assert any(
+        f["rule"] == "halo-permute-count" and f["severity"] == "error"
+        for f in low.findings
+    )
+    # halo_shifts=0 derives a ceiling of 0 permutes (+extra widens it).
+    high = analyze_hlo_text(OVERLAPPED, expected=Expectations(halo_shifts=0))
+    assert any(f["rule"] == "halo-permute-count" for f in high.findings)
+    widened = analyze_hlo_text(
+        OVERLAPPED, expected=Expectations(halo_shifts=0, extra_permutes=1)
+    )
+    assert not any(
+        f["rule"] == "halo-permute-count" for f in widened.findings
+    )
+
+
+def test_memory_regression_rule():
+    mem = {"peak_bytes": 1_100_000, "baseline_bytes": 1_000_000,
+           "tolerance": 0.05}
+    report = analyze_hlo_text(OVERLAPPED, memory=mem)
+    assert any(
+        f["rule"] == "peak-memory-regression" and f["severity"] == "error"
+        for f in report.findings
+    )
+    mem_ok = dict(mem, peak_bytes=1_010_000)
+    report = analyze_hlo_text(OVERLAPPED, memory=mem_ok)
+    assert not any(
+        f["severity"] == "error" for f in report.findings
+    )
+    no_base = {"peak_bytes": 123}
+    report = analyze_hlo_text(OVERLAPPED, memory=no_base)
+    assert any(
+        f["rule"] == "peak-memory-regression" and f["severity"] == "info"
+        for f in report.findings
+    )
+
+
+def test_remat_effectiveness_rule():
+    ineffective = {"policy": "scanq", "store_budget_mb": 100,
+                   "granted_bytes": 0, "grants": {}}
+    report = analyze_hlo_text(OVERLAPPED, remat=ineffective)
+    assert any(
+        f["rule"] == "remat-effectiveness" and f["severity"] == "warn"
+        for f in report.findings
+    )
+    overgrant = {"policy": "scanq", "store_budget_mb": 1,
+                 "granted_bytes": 50_000_000, "grants": {0: 50_000_000}}
+    report = analyze_hlo_text(OVERLAPPED, remat=overgrant)
+    assert any(
+        f["rule"] == "remat-effectiveness" and f["severity"] == "error"
+        for f in report.findings
+    )
+
+
+def test_report_json_round_trip(tmp_path):
+    report = analyze_hlo_text(BAD, platform="cpu", config={"model": "canned"})
+    blob = json.loads(report.to_json())
+    assert blob["ok"] is False
+    assert blob["inventory"]["all-to-all"] == 1
+    assert blob["config"] == {"model": "canned"}
+    assert blob["overlap"]["async_pairs"] == 1
+    assert {f["rule"] for f in blob["findings"]} >= {
+        "stray-all-to-all", "zero-overlap-collective",
+    }
+
+
+def test_max_severity_ordering():
+    from mpi4dl_tpu.analysis.rules import Finding
+
+    assert max_severity([]) is None
+    fs = [Finding("r", "info", "m"), Finding("r", "warn", "m")]
+    assert max_severity(fs) == "warn"
+    fs.append(Finding("r", "error", "m"))
+    assert max_severity(fs) == "error"
+
+
+def test_cli_on_compiled_program(tmp_path, monkeypatch):
+    """End-to-end: the analyzer CLI compiles the small spatial resnet on
+    the test mesh, writes a JSON report with inventory + bytes + overlap
+    + memory, and exits 0 (no error findings on the real engine)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from mpi4dl_tpu.analysis.cli import main
+
+    out = tmp_path / "report.json"
+    rc = main([
+        "--model", "resnet", "--size", "32", "--batch", "4",
+        "--json", str(out),
+    ])
+    assert rc == 0
+    blob = json.loads(out.read_text())
+    assert blob["ok"] is True
+    assert blob["inventory"]["collective-permute"] == 36
+    assert blob["config"]["halo_shifts"] == 20
+    assert blob["overlap"]["total_bytes"] > 0
+    assert all(r["bytes_moved"] > 0 for r in blob["collectives"])
+    # memory_analysis works on the CPU backend, so peak must be present.
+    assert blob["memory"]["peak_bytes"] > 0
