@@ -17,6 +17,10 @@ AmoebaNet@1024 number ~3.0 img/s (multi-GPU MVAPICH2-GDR cluster; read off
 
 Every entry also reports MFU (model-FLOPs utilization, analytic conv+dot
 count — see mpi4dl_tpu/flops.py); the north star is ≥45% (BASELINE.json).
+Train entries carry p50/p90/p99 step-time tails (``step_time_s``), and a
+``serving_*`` extra measures the online serving engine (mpi4dl_tpu/serve):
+dynamic micro-batching throughput vs the batch-size-1 serial baseline with
+request-latency percentiles (``BENCH_SERVING=0`` disables).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -237,6 +241,8 @@ def _train_throughput(
     )
     y = jnp.asarray(rng.integers(0, 10, size=(batch,)), jnp.int32)
 
+    from mpi4dl_tpu.profiling import StepTimer
+
     state = trainer = None
     for remat in remats:
         try:
@@ -277,15 +283,95 @@ def _train_throughput(
             print(f"# remat={remat} failed ({msg[:80]!r}); retrying leaner", flush=True)
             state = trainer = None
 
-    t0 = time.perf_counter()
+    # Per-step timing (StepTimer): each step ends on the same forced
+    # device READ as the warm-up (the readiness-without-execution guard
+    # above), so the recorded times carry real per-step boundaries and the
+    # summary's p50/p90/p99 are genuine step-latency tails — the statistic
+    # the serving work needs result lines to carry. The per-step scalar
+    # read costs one D2H round trip per multi-second step (<1% here) and
+    # only tightens the measurement: dispatch pipelining can no longer
+    # smear one slow step across its neighbors.
+    timer = StepTimer(batch_size=batch, warmup=0)
     for _ in range(steps):
-        state, metrics = trainer.train_step(state, xs, ys)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
+        with timer.step():
+            state, metrics = trainer.train_step(state, xs, ys)
+            float(metrics["loss"])
+    dt = sum(timer.times)
     # Stash the measured program for the post-headline static analysis
     # (mpi4dl_tpu.analysis): re-lowering it is a warm-cache no-op.
     _LAST_RUN.update(trainer=trainer, state=state, xs=xs, ys=ys)
-    return batch * steps / dt, trainer.remat
+    return batch * steps / dt, trainer.remat, timer.summary()
+
+
+def _step_percentiles(steps_summary: dict) -> dict:
+    """p50/p90/p99 step-time tails from a StepTimer summary — serving-grade
+    tail statistics in every train result line, not just means."""
+    return {
+        p: round(steps_summary[f"step_time_{p}_s"], 4)
+        for p in ("p50", "p90", "p99")
+        if f"step_time_{p}_s" in steps_summary
+    }
+
+
+def _measure_serving() -> dict:
+    """Online-serving extra: dynamic micro-batching throughput vs the
+    batch-size-1 serial baseline (mpi4dl_tpu/serve, docs/SERVING.md) on a
+    small calibrated AmoebaNet — many small ops per cell, the op-overhead-
+    bound shape the per-call dispatch floor (~23 ms on the TPU runtime,
+    PERF.md) penalizes hardest, i.e. where batching IS the serving story.
+    The result line carries the tail percentiles serving is judged by."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop, serial_throughput
+
+    size = 32
+    cells = amoebanetd(num_classes=10, num_layers=3, num_filters=16)
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+    engine = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3),
+        buckets=(1, 32), max_wait_s=0.003, max_queue=512,
+        default_deadline_s=30.0,
+    )
+    serial = serial_throughput(engine, 32)
+    engine.start()
+    try:
+        rep = run_closed_loop(engine, 384, concurrency=96, deadline_s=30.0)
+    finally:
+        engine.stop()
+    lint = engine.lint_report()
+    entry = {
+        "value": round(rep["throughput_rps"], 1),
+        "serial_bs1_rps": round(serial["throughput_rps"], 1),
+        "speedup_vs_serial": round(
+            rep["throughput_rps"] / serial["throughput_rps"], 2
+        ),
+        "latency_ms": {
+            k: round(v * 1e3, 2)
+            for k, v in rep["latency_s"].items()
+            if v is not None
+        },
+        "mean_batch_size": round(rep["engine"]["mean_batch_size"], 1),
+        "deadline_misses": rep["deadline_misses"],
+        "rejected": rep["rejected_queue_full"],
+        "lint_ok": lint.ok,
+    }
+    if not lint.ok:
+        entry["lint_findings"] = [
+            f for f in lint.findings if f["severity"] == "error"
+        ]
+    return entry
 
 
 def _hlo_overlap_metrics() -> "dict | None":
@@ -398,7 +484,7 @@ def main():
             depth=depth, num_classes=10, pool_kernel=size // 4,
             layout=layout, dtype=dtype,
         )
-        ips, remat = _train_throughput(
+        ips, remat, steps_summary = _train_throughput(
             cells, size, b, steps, warmup, dtype, remats_for(size, remats)
         )
         logical = get_resnet_v2(
@@ -413,6 +499,7 @@ def main():
             "value": round(ips, 3),
             "remat": remat,
             "mfu": round(util, 4) if util is not None else None,
+            "step_time_s": _step_percentiles(steps_summary),
             "vs_baseline": round(ips / baseline, 3),
         }
 
@@ -448,7 +535,7 @@ def main():
             os.environ["MPI4DL_TPU_SAVE_BUDGET_MB"] = "6000"
             remats = ["scan_save", "scan"]
         try:
-            ips, remat = _train_throughput(
+            ips, remat, steps_summary = _train_throughput(
                 cells, size, b, steps, warmup, dtype,
                 remats, grad_accum=accum,
             )
@@ -466,6 +553,7 @@ def main():
             "value": round(ips, 3),
             "remat": remat,
             "mfu": round(util, 4) if util is not None else None,
+            "step_time_s": _step_percentiles(steps_summary),
         }
         if accum > 1:
             entry["grad_accum"] = accum
@@ -581,6 +669,13 @@ def main():
                 functools.partial(measure_amoeba, size, b),
                 est_seconds=300.0,
             )
+
+    # Online-serving workload (any platform: the engine is single-chip by
+    # design). Runs before the peak-pixel walk — the walk is expected to
+    # eventually fail/eat budget and must not starve this measurement.
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        run_extra("serving_amoebanet3_32px", _measure_serving,
+                  est_seconds=180.0)
 
     if which in ("resnet", "all") and not on_cpu:
         def peak_px():
@@ -726,7 +821,7 @@ def main():
                 if scanq_default:
                     os.environ["MPI4DL_TPU_SCANQ_STORE_MB"] = "3000"
                 try:
-                    ips, _ = _train_throughput(
+                    ips, _, _ = _train_throughput(
                         cells, size, 1, 3, 1, dtype, walk_remats
                     )
                 except Exception as e:  # noqa: BLE001 — walk stops here

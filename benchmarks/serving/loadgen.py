@@ -1,0 +1,23 @@
+"""Serving load-generator benchmark — thin entry over
+``python -m mpi4dl_tpu.serve`` (the implementation lives in
+:mod:`mpi4dl_tpu.serve.loadgen` so tests and bench.py import it as a
+library; this script exists so serving benchmarks live next to the
+training ones).
+
+Examples::
+
+    # closed loop on the CPU backend, synthetic calibrated ResNet
+    JAX_PLATFORMS=cpu python benchmarks/serving/loadgen.py \
+        --requests 128 --concurrency 32 --max-batch 8
+
+    # open loop at a fixed offered rate against a real checkpoint
+    python benchmarks/serving/loadgen.py --ckpt /ckpts/run1 \
+        --mode open --rate 200 --duration 10 --deadline-ms 50 --lint
+"""
+
+import sys
+
+from mpi4dl_tpu.serve.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,11 @@ class Expectations:
     # True when the program is expected to have NO spatial/model sharding
     # (pure DP): any permute/gather/scatter then means resharding crept in.
     pure_dp: bool = False
+    # True for a program that must run entirely on one chip (the serving
+    # forward): ANY collective — all-reduce included — is then XLA
+    # resharding/replicating something that regressed off the single
+    # device, turning every request into cross-chip traffic.
+    single_chip: bool = False
 
 
 @dataclasses.dataclass
@@ -109,6 +114,22 @@ def _rule_stray_resharding(ctx: LintContext) -> list[Finding]:
                 "parameter sharding regressed.",
             ))
     return out
+
+
+def _rule_single_chip_collectives(ctx: LintContext) -> list[Finding]:
+    if not ctx.expected.single_chip:
+        return []
+    present = {op: n for op, n in ctx.inventory.items() if n}
+    if not present:
+        return []
+    ops = ", ".join(f"{n} {op}" for op, n in sorted(present.items()))
+    return [Finding(
+        "single-chip-collectives", "error",
+        f"single-chip program contains collectives ({ops}): the serving "
+        "forward must compile to a one-device executable — a collective "
+        "here means an input/param landed sharded or a mesh leaked into "
+        "the eval path, and every request would pay cross-chip latency.",
+    )]
 
 
 def _rule_halo_permute_count(ctx: LintContext) -> list[Finding]:
@@ -230,6 +251,9 @@ DEFAULT_RULES: tuple[Rule, ...] = (
          "any all-to-all is a resharding bug", _rule_stray_all_to_all),
     Rule("stray-resharding",
          "pure-DP programs may only all-reduce", _rule_stray_resharding),
+    Rule("single-chip-collectives",
+         "single-chip (serving) programs may not communicate at all",
+         _rule_single_chip_collectives),
     Rule("halo-permute-count",
          "collective-permute count must sit in the partition-math window",
          _rule_halo_permute_count),

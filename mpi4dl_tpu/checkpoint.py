@@ -12,7 +12,18 @@ Format: one directory per step (``step_0000100/``) holding
 - ``state.msgpack`` — the full ``TrainState`` pytree (params, optimizer
   state, step) via ``flax.serialization`` (framework-independent msgpack,
   no pickling of code);
-- ``meta.json`` — step number + user metadata.
+- ``meta.json`` — step number + user metadata (see :func:`model_metadata`
+  for the canonical model-config block);
+- ``batch_stats.msgpack`` (optional) — calibrated BN statistics
+  (:func:`mpi4dl_tpu.evaluate.collect_batch_stats` output), so an
+  inference/serving process can restore a ready-to-predict model without
+  re-running calibration.
+
+A checkpoint whose ``meta.json`` carries a :func:`model_metadata` block is
+*self-describing*: :func:`rebuild_from_checkpoint` reconstructs the cell
+list from the metadata alone, so eval and the serving engine
+(:mod:`mpi4dl_tpu.serve`) start from a checkpoint path with no side-channel
+model config.
 
 Arrays are pulled to host before writing (``jax.device_get``), so saving
 works identically for sharded (multi-chip) and single-device states; on
@@ -29,6 +40,7 @@ import shutil
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 from flax import serialization
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
@@ -40,10 +52,12 @@ def save_checkpoint(
     step: int | None = None,
     keep: int = 3,
     metadata: dict | None = None,
+    batch_stats: Any | None = None,
 ) -> str:
     """Write ``state`` under ``ckpt_dir/step_{step}``; prune to ``keep``
     newest. Returns the checkpoint path. ``step`` defaults to
-    ``int(state.step)``."""
+    ``int(state.step)``. ``batch_stats`` (calibrated BN statistics) ride
+    along in ``batch_stats.msgpack`` when given."""
     if step is None:
         step = int(jax.device_get(state.step))
     path = os.path.join(ckpt_dir, f"step_{step:08d}")
@@ -52,6 +66,9 @@ def save_checkpoint(
     host_state = jax.device_get(state)
     with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(host_state))
+    if batch_stats is not None:
+        with open(os.path.join(tmp, "batch_stats.msgpack"), "wb") as f:
+            f.write(serialization.to_bytes(jax.device_get(batch_stats)))
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump({"step": step, **(metadata or {})}, f)
     if os.path.exists(path):
@@ -88,12 +105,7 @@ def restore_checkpoint(path_or_dir: str, target: Any) -> Any:
     """Restore a state pytree. ``target`` supplies the structure (a freshly
     ``init()``-ed ``TrainState``); pass a checkpoint path or a directory (→
     newest). Raises ``FileNotFoundError`` when nothing is there."""
-    path = path_or_dir
-    if not os.path.exists(os.path.join(path, "state.msgpack")):
-        newest = latest_checkpoint(path_or_dir)
-        if newest is None:
-            raise FileNotFoundError(f"no checkpoint under {path_or_dir!r}")
-        path = newest
+    path = resolve_checkpoint(path_or_dir)
     with open(os.path.join(path, "state.msgpack"), "rb") as f:
         return serialization.from_bytes(target, f.read())
 
@@ -101,3 +113,113 @@ def restore_checkpoint(path_or_dir: str, target: Any) -> Any:
 def checkpoint_metadata(path: str) -> dict:
     with open(os.path.join(path, "meta.json")) as f:
         return json.load(f)
+
+
+def resolve_checkpoint(path_or_dir: str) -> str:
+    """Exact checkpoint path for a checkpoint dir (→ newest) or a direct
+    ``step_*`` path (→ itself). Raises ``FileNotFoundError`` when empty."""
+    if os.path.exists(os.path.join(path_or_dir, "state.msgpack")):
+        return path_or_dir
+    newest = latest_checkpoint(path_or_dir)
+    if newest is None:
+        raise FileNotFoundError(f"no checkpoint under {path_or_dir!r}")
+    return newest
+
+
+# -- self-describing checkpoints: model metadata + rebuild --------------------
+
+# family -> builder resolver, lazily imported so checkpoint stays cheap to
+# import (the model zoo pulls in flax modules).
+_MODEL_FAMILIES = ("resnet_v1", "resnet_v2", "amoebanet")
+
+
+def model_metadata(family: str, image_size: int, **spec) -> dict:
+    """Canonical ``{"model": {...}}`` metadata block for
+    :func:`save_checkpoint`: everything :func:`rebuild_cells` needs to
+    reconstruct the cell list, plus the input geometry
+    (``image_size``/``channels``) a restore-time ``init`` needs to build
+    the target pytree. ``spec`` holds the family builder's kwargs (depth /
+    num_layers / num_filters / num_classes / pool_kernel / layout ...);
+    a ``dtype`` entry may be a dtype object — it is stored by name."""
+    if family not in _MODEL_FAMILIES:
+        raise ValueError(
+            f"unknown model family {family!r}; expected one of {_MODEL_FAMILIES}"
+        )
+    if "dtype" in spec:
+        spec["dtype"] = jnp.dtype(spec["dtype"]).name
+    return {"model": {"family": family, "image_size": int(image_size), **spec}}
+
+
+def rebuild_cells(meta: dict) -> list:
+    """Reconstruct the cell list from a :func:`model_metadata` block (the
+    ``meta.json`` of a self-describing checkpoint)."""
+    try:
+        spec = dict(meta["model"])
+    except KeyError:
+        raise ValueError(
+            "checkpoint metadata has no 'model' block — it was saved without "
+            "model_metadata(...) and cannot be rebuilt from the path alone"
+        ) from None
+    family = spec.pop("family")
+    spec.pop("image_size", None)
+    spec.pop("channels", None)
+    if "dtype" in spec:
+        spec["dtype"] = jnp.dtype(spec["dtype"])
+    if family == "resnet_v1":
+        from mpi4dl_tpu.models.resnet import get_resnet_v1
+
+        return get_resnet_v1(**spec)
+    if family == "resnet_v2":
+        from mpi4dl_tpu.models.resnet import get_resnet_v2
+
+        return get_resnet_v2(**spec)
+    if family == "amoebanet":
+        from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+        return amoebanetd(**spec)
+    raise ValueError(
+        f"unknown model family {family!r}; expected one of {_MODEL_FAMILIES}"
+    )
+
+
+def restore_batch_stats(path_or_dir: str):
+    """Calibrated BN ``batch_stats`` from a checkpoint, or ``None`` when the
+    checkpoint was saved without them. Returned as the same list-of-dicts
+    :func:`mpi4dl_tpu.evaluate.collect_batch_stats` produces (flax msgpack
+    stores lists as index-keyed dicts; this undoes that)."""
+    path = resolve_checkpoint(path_or_dir)
+    fname = os.path.join(path, "batch_stats.msgpack")
+    if not os.path.exists(fname):
+        return None
+    with open(fname, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    return [raw[str(i)] for i in range(len(raw))]
+
+
+def rebuild_from_checkpoint(path_or_dir: str):
+    """``(cells, state, batch_stats, meta)`` from a checkpoint path alone.
+
+    The cell list comes from the metadata model block; the restore target
+    (params + optimizer-state structure) is built by initializing those
+    cells at the recorded input geometry — callers need no side-channel
+    model config. ``batch_stats`` is ``None`` for train-only checkpoints."""
+    path = resolve_checkpoint(path_or_dir)
+    meta = checkpoint_metadata(path)
+    cells = rebuild_cells(meta)
+    spec = meta["model"]
+    shape = (
+        1, spec["image_size"], spec["image_size"], spec.get("channels", 3)
+    )
+
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.train import TrainState, make_optimizer
+
+    x = jnp.zeros(shape, jnp.dtype(spec.get("dtype", "float32")))
+    params = init_cells(cells, jax.random.PRNGKey(0), x)
+    target = TrainState(
+        params=params,
+        opt_state=make_optimizer().init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+    state = restore_checkpoint(path, target)
+    return cells, state, restore_batch_stats(path), meta

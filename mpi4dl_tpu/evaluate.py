@@ -152,6 +152,38 @@ def make_eval_step(cells: Sequence[Any]):
     return _eval_step_for(tuple(cells))
 
 
+def aot_compile_predict(
+    cells: Sequence[Any],
+    params: Sequence[Any],
+    batch_stats,
+    example_shape: Sequence[int],
+    buckets: Sequence[int],
+    dtype=jnp.float32,
+) -> dict:
+    """AOT-lower the frozen-stats forward once per batch bucket.
+
+    Returns ``{bucket: compiled}`` where each value is a ready
+    ``jax.stages.Compiled`` executable for input shape
+    ``(bucket, *example_shape)``. Compilation happens here — at serving
+    warm-up — and never again: calling a ``Compiled`` object cannot trace
+    or compile, so a request loop built on these executables is
+    structurally incapable of paying a surprise JIT (the serving engine's
+    no-compile-after-warm-up guarantee rests on this).
+    """
+    cells = tuple(cells)
+
+    def fwd(p, s, x):
+        return _apply_running(cells, p, s, x)
+
+    out = {}
+    for b in sorted({int(b) for b in buckets}):
+        if b < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {b}")
+        xs = jax.ShapeDtypeStruct((b, *tuple(example_shape)), dtype)
+        out[b] = jax.jit(fwd).lower(params, batch_stats, xs).compile()
+    return out
+
+
 def evaluate(
     cells: Sequence[Any], params: Sequence[Any], batch_stats, batches
 ) -> dict:

@@ -7,7 +7,11 @@ equivalents:
 - :class:`StepTimer` — host wall-clock per step with ``block_until_ready``
   (async dispatch means a bare ``time.time()`` measures nothing), tracking
   the same statistics every reference benchmark prints (per-step seconds,
-  images/sec, mean/median);
+  images/sec, mean/median) plus p50/p90/p99 tail percentiles — a serving
+  path lives and dies by tail latency, not means;
+- :func:`percentiles` — the shared percentile helper (linear interpolation
+  on the sorted sample, numpy's default method) used by :class:`StepTimer`
+  and the serving load generator;
 - :func:`trace` — ``jax.profiler`` trace context writing a TensorBoard/XProf
   trace directory (device timelines, HLO cost, ICI collectives); enabled by
   path or the ``MPI4DL_TPU_TRACE_DIR`` env var, no-op otherwise.
@@ -20,6 +24,22 @@ import os
 import statistics
 import time
 from typing import Any
+
+
+def percentiles(values, pcts=(50, 90, 99)) -> dict:
+    """``{"p50": v, ...}`` by linear interpolation on the sorted sample
+    (numpy's default "linear" method, hand-rolled so callers measuring
+    latency need no array round-trip). Empty input → empty dict."""
+    vals = sorted(values)
+    if not vals:
+        return {}
+    out = {}
+    for p in pcts:
+        rank = (len(vals) - 1) * p / 100.0
+        lo = int(rank)
+        hi = min(lo + 1, len(vals) - 1)
+        out[f"p{p:g}"] = vals[lo] + (vals[hi] - vals[lo]) * (rank - lo)
+    return out
 
 
 class StepTimer:
@@ -63,13 +83,16 @@ class StepTimer:
         if not self.times:
             return {"steps": 0}
         ips = self.images_per_sec
-        return {
+        out = {
             "steps": len(self.times),
             "step_time_mean_s": statistics.mean(self.times),
             "step_time_median_s": statistics.median(self.times),
             "images_per_sec_mean": statistics.mean(ips),
             "images_per_sec_median": statistics.median(ips),
         }
+        for k, v in percentiles(self.times).items():
+            out[f"step_time_{k}_s"] = v
+        return out
 
 
 @contextlib.contextmanager

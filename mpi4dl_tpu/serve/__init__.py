@@ -1,0 +1,35 @@
+"""Online serving: the inference workload the north star demands.
+
+The reference framework never evaluates, let alone serves;
+:mod:`mpi4dl_tpu.evaluate` added offline batch eval, and this package adds
+the online path: a :class:`ServingEngine` that restores a calibrated model
+from a self-describing checkpoint, pre-compiles one executable per
+power-of-two batch bucket at startup (no request ever pays a JIT), and
+runs a dynamic micro-batching request loop — bounded-queue admission
+control, per-request deadlines, max-wait/max-size batch formation,
+right-padding into the nearest bucket, and double-buffered host→device
+staging so the next batch's transfer overlaps the current batch's compute.
+
+Entry points:
+
+- :class:`ServingEngine` / :meth:`ServingEngine.from_checkpoint` — the
+  library surface;
+- ``python -m mpi4dl_tpu.serve`` — CLI: restore (or synthesize) a model,
+  warm up, drive a closed/open-loop load test, print one JSON report;
+- :mod:`mpi4dl_tpu.serve.loadgen` — the load-generation library behind
+  ``benchmarks/serving/`` and the bench.py serving hook.
+
+See ``docs/SERVING.md`` for architecture, bucket policy, and deadline
+semantics.
+"""
+
+from mpi4dl_tpu.serve.batching import (  # noqa: F401
+    bucket_for,
+    pad_batch,
+    power_of_two_buckets,
+)
+from mpi4dl_tpu.serve.engine import (  # noqa: F401
+    DeadlineExceededError,
+    QueueFullError,
+    ServingEngine,
+)

@@ -1,0 +1,167 @@
+"""``python -m mpi4dl_tpu.serve`` — start a serving engine and load-test it.
+
+Restores a self-describing checkpoint (``--ckpt``) or builds a synthetic
+calibrated ResNet (default — no artifacts needed), AOT-warms every bucket,
+runs the requested load model, and prints ONE JSON report line to stdout
+(bench.py's keep-the-last-line protocol). ``--lint`` additionally gates
+the serving executable's HLO through hlolint (zero collectives on the
+single-chip path) and fails the process on error-severity findings.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.serve --requests 64
+    python -m mpi4dl_tpu.serve --ckpt /ckpts/run1 --mode open \
+        --rate 200 --duration 10 --deadline-ms 50 --lint
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.serve",
+        description="mpi4dl_tpu online serving engine + load generator",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--ckpt", default=None,
+                   help="self-describing checkpoint dir/path "
+                        "(default: synthetic calibrated ResNet)")
+    p.add_argument("--depth", type=int, default=11,
+                   help="synthetic ResNet-v2 depth (9n+2)")
+    p.add_argument("--image-size", type=int, default=32,
+                   help="synthetic model input size")
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--calib-batches", type=int, default=2,
+                   help="synthetic BN calibration batches")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="largest micro-batch bucket (power of two)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="batch formation window")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission-control queue bound")
+    p.add_argument("--deadline-ms", type=float, default=10000.0,
+                   help="per-request deadline")
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--requests", type=int, default=64,
+                   help="closed loop: total requests")
+    p.add_argument("--concurrency", type=int, default=16,
+                   help="closed loop: client count")
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="open loop: offered requests/sec")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="open loop: seconds")
+    p.add_argument("--serial", type=int, default=16,
+                   help="batch-size-1 serial baseline requests (0 skips)")
+    p.add_argument("--lint", action="store_true",
+                   help="hlolint the serving executable; fail on errors")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the report JSON here")
+    return p
+
+
+def _synthetic_engine(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+
+    size = args.image_size
+    cells = get_resnet_v2(
+        depth=args.depth, num_classes=args.classes, pool_kernel=size // 4
+    )
+    rng = np.random.default_rng(0)
+    x0 = jnp.zeros((1, size, size, 3), jnp.float32)
+    params = init_cells(cells, jax.random.PRNGKey(0), x0)
+    cal = [
+        jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)
+        for _ in range(args.calib_batches)
+    ]
+    stats = collect_batch_stats(cells, params, cal)
+    return ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3),
+        max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
+        max_queue=args.max_queue,
+        default_deadline_s=args.deadline_ms / 1e3,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from mpi4dl_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.serve.loadgen import (
+        run_closed_loop,
+        run_open_loop,
+        serial_throughput,
+    )
+
+    if args.ckpt:
+        engine = ServingEngine.from_checkpoint(
+            args.ckpt, max_batch=args.max_batch,
+            max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
+            default_deadline_s=args.deadline_ms / 1e3,
+        )
+    else:
+        engine = _synthetic_engine(args)
+
+    report = {
+        "model": "checkpoint:" + args.ckpt if args.ckpt else
+                 f"synthetic_resnet{args.depth}_{args.image_size}px",
+        "buckets": list(engine.buckets),
+    }
+    if args.serial:
+        report["serial"] = serial_throughput(engine, args.serial)
+
+    engine.start()
+    try:
+        if args.mode == "closed":
+            report["loadgen"] = run_closed_loop(
+                engine, args.requests, concurrency=args.concurrency,
+                deadline_s=args.deadline_ms / 1e3,
+            )
+        else:
+            report["loadgen"] = run_open_loop(
+                engine, rate_rps=args.rate, duration_s=args.duration,
+                deadline_s=args.deadline_ms / 1e3,
+            )
+    finally:
+        engine.stop()
+
+    if args.serial and report["serial"]["throughput_rps"] > 0:
+        report["speedup_vs_serial"] = (
+            report["loadgen"]["throughput_rps"]
+            / report["serial"]["throughput_rps"]
+        )
+
+    lint_failed = False
+    if args.lint:
+        rep = engine.lint_report()
+        report["lint"] = {
+            "ok": rep.ok,
+            "summary": rep.summary_line(),
+            "findings": rep.findings,
+        }
+        lint_failed = not rep.ok
+
+    line = json.dumps(report)
+    print(line, flush=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 2 if lint_failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,365 @@
+"""Online serving engine: AOT warm-up + dynamic micro-batching request loop.
+
+Design (the T3/FLUX lesson applied to single-chip inference — overlap data
+movement with compute, and never let the hot loop pay a compile):
+
+- **AOT warm-up.** At construction every configured batch bucket is lowered
+  and compiled through :func:`mpi4dl_tpu.evaluate.aot_compile_predict`, then
+  executed once on zeros. After warm-up the loop only ever *calls*
+  ``jax.stages.Compiled`` executables, which structurally cannot trace or
+  recompile — the no-surprise-JIT guarantee is an object-capability fact,
+  not a convention, and :meth:`ServingEngine.assert_warm` checks every
+  bucket has its executable before the loop starts.
+- **Admission control.** The request queue is bounded; a full queue rejects
+  at ``submit`` (:class:`QueueFullError`) instead of building unbounded
+  latency. Per-request deadlines are enforced twice: requests already
+  expired at batch-formation time are rejected without being served, and a
+  result that lands past its deadline is delivered as
+  :class:`DeadlineExceededError`, never silently late.
+- **Batch formation.** The batcher pops the first waiting request, then
+  collects up to ``max_batch`` requests or ``max_wait_s`` seconds —
+  whichever ends first — and right-pads into the smallest power-of-two
+  bucket (:mod:`mpi4dl_tpu.serve.batching`).
+- **Double-buffered staging.** The loop stages batch *k+1* host→device
+  (``jax.device_put``) and dispatches its executable — both asynchronous —
+  *before* blocking on batch *k*'s results, so the next batch's transfer
+  and the host-side batch formation overlap the current batch's device
+  compute. One batch is in flight at all times under load.
+
+Thread model: clients call :meth:`submit` from any thread (it only touches
+the bounded queue); a single batcher thread owns all JAX dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Sequence
+
+import numpy as np
+
+from mpi4dl_tpu.profiling import percentiles
+from mpi4dl_tpu.serve.batching import bucket_for, pad_batch, power_of_two_buckets
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the bounded request queue is full."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before a result could be delivered."""
+
+
+@dataclasses.dataclass
+class _Request:
+    x: np.ndarray
+    submit_t: float
+    deadline: float
+    future: Future
+
+
+class ServingEngine:
+    """Serves single-example requests through pre-compiled bucketed
+    frozen-stats forwards of a calibrated model.
+
+    cells/params/batch_stats: the :mod:`mpi4dl_tpu.evaluate` triple (plain
+        cell list, its params, calibrated BN stats).
+    example_shape: per-request input shape, e.g. ``(H, W, 3)``.
+    max_batch: largest micro-batch; buckets default to
+        ``(1, 2, ..., max_batch)`` powers of two.
+    max_wait_s: batch-formation window after the first queued request.
+    max_queue: admission-control bound on waiting requests.
+    default_deadline_s: per-request deadline when ``submit`` gives none.
+    """
+
+    def __init__(
+        self,
+        cells: Sequence[Any],
+        params: Sequence[Any],
+        batch_stats,
+        example_shape: Sequence[int],
+        dtype=None,
+        max_batch: int = 8,
+        buckets: Sequence[int] | None = None,
+        max_wait_s: float = 0.002,
+        max_queue: int = 64,
+        default_deadline_s: float = 1.0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from mpi4dl_tpu.evaluate import aot_compile_predict
+
+        dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+        self._np_dtype = np.dtype(dtype.name)
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self._buckets = (
+            tuple(sorted({int(b) for b in buckets}))
+            if buckets is not None
+            else power_of_two_buckets(max_batch)
+        )
+        self._max_batch = max(self._buckets)
+        self._max_wait_s = float(max_wait_s)
+        self._default_deadline_s = float(default_deadline_s)
+        self._device = jax.devices()[0]
+        # Params/stats live on the device once; per-request traffic is the
+        # input batch only.
+        self._params = jax.device_put(params, self._device)
+        self._stats = jax.device_put(batch_stats, self._device)
+
+        # AOT warm-up: compile every bucket now, then run each once so the
+        # first real request pays neither a compile nor a first-exec setup.
+        self._compiled = aot_compile_predict(
+            cells, self._params, self._stats, self.example_shape,
+            self._buckets, dtype=dtype,
+        )
+        self.warm_latency_s: dict[int, float] = {}
+        for b in self._buckets:
+            z = np.zeros((b, *self.example_shape), self._np_dtype)
+            t0 = time.perf_counter()
+            np.asarray(self._compiled[b](self._params, self._stats, z))
+            self.warm_latency_s[b] = time.perf_counter() - t0
+        self.assert_warm()
+
+        self._q: queue.Queue[_Request] = queue.Queue(maxsize=max_queue)
+        self._poll_s = 0.02
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._counts = {
+            "submitted": 0,
+            "rejected_queue_full": 0,
+            "rejected_deadline": 0,
+            "served": 0,
+            "served_late": 0,
+            "batches": 0,
+            "batched_examples": 0,
+        }
+        self._latencies: list[float] = []
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, path_or_dir: str, **kw) -> "ServingEngine":
+        """Engine from a self-describing checkpoint path alone: metadata →
+        rebuilt cells, restored params, calibrated ``batch_stats`` (which
+        must have been saved — serving without calibration would silently
+        use garbage BN statistics)."""
+        from mpi4dl_tpu.checkpoint import rebuild_from_checkpoint
+
+        cells, state, stats, meta = rebuild_from_checkpoint(path_or_dir)
+        if stats is None:
+            raise ValueError(
+                "checkpoint has no batch_stats.msgpack — calibrate with "
+                "evaluate.collect_batch_stats and save_checkpoint(..., "
+                "batch_stats=...) before serving"
+            )
+        spec = meta["model"]
+        shape = (
+            spec["image_size"], spec["image_size"], spec.get("channels", 3)
+        )
+        kw.setdefault("dtype", spec.get("dtype", "float32"))
+        return cls(cells, state.params, stats, example_shape=shape, **kw)
+
+    # -- public surface ------------------------------------------------------
+
+    @property
+    def buckets(self) -> tuple[int, ...]:
+        return self._buckets
+
+    def assert_warm(self) -> None:
+        """Every configured bucket must have its pre-built executable —
+        the no-compile-after-warm-up contract."""
+        missing = [b for b in self._buckets if b not in self._compiled]
+        if missing:
+            raise AssertionError(
+                f"buckets {missing} have no pre-compiled executable; the "
+                "serving loop would have to JIT on a live request"
+            )
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="mpi4dl-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the batcher. ``drain=True`` serves what is already queued
+        first; ``drain=False`` fails queued requests immediately."""
+        if not drain:
+            self._flush_queue("engine stopped before this request was served")
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._flush_queue("engine stopped before this request was served")
+
+    def submit(self, x, deadline_s: float | None = None) -> Future:
+        """Enqueue one example; returns a ``Future`` resolving to its
+        logits. Raises :class:`QueueFullError` when admission control
+        rejects; the future raises :class:`DeadlineExceededError` when the
+        deadline passes before delivery."""
+        x = np.asarray(x, self._np_dtype)
+        if x.shape != self.example_shape:
+            raise ValueError(
+                f"example shape {x.shape} != configured {self.example_shape}"
+            )
+        if self._stop_evt.is_set() and self._thread is None:
+            raise RuntimeError("engine is stopped; call start() first")
+        now = time.monotonic()
+        ddl = now + (
+            deadline_s if deadline_s is not None else self._default_deadline_s
+        )
+        req = _Request(x=x, submit_t=now, deadline=ddl, future=Future())
+        with self._lock:
+            self._counts["submitted"] += 1
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._lock:
+                self._counts["rejected_queue_full"] += 1
+            raise QueueFullError(
+                f"request queue full ({self._q.maxsize} waiting)"
+            ) from None
+        return req.future
+
+    def predict_one(self, x) -> np.ndarray:
+        """Synchronous batch-size-1 forward through the bucket-1
+        executable, bypassing the queue — the serial baseline the load
+        generator compares dynamic batching against."""
+        x = np.asarray(x, self._np_dtype)
+        batch = pad_batch([x], bucket_for(1, self._buckets), self._np_dtype)
+        out = self._compiled[bucket_for(1, self._buckets)](
+            self._params, self._stats, batch
+        )
+        return np.asarray(out)[0]
+
+    def stats(self) -> dict:
+        """Counter snapshot + served-latency percentiles (seconds)."""
+        with self._lock:
+            out = dict(self._counts)
+            lat = list(self._latencies)
+        out["latency_s"] = percentiles(lat)
+        if out["batches"]:
+            out["mean_batch_size"] = out["batched_examples"] / out["batches"]
+        out["buckets"] = list(self._buckets)
+        out["warm_latency_s"] = dict(self.warm_latency_s)
+        return out
+
+    def lint_report(self, bucket: int | None = None):
+        """hlolint gate over a serving executable's HLO: the single-chip
+        serve path must contain zero collectives and no stray resharding
+        (:mod:`mpi4dl_tpu.analysis`, rule ``single-chip-collectives``)."""
+        from mpi4dl_tpu.analysis import analyze_compiled
+        from mpi4dl_tpu.analysis.rules import Expectations
+
+        b = bucket if bucket is not None else max(self._buckets)
+        return analyze_compiled(
+            self._compiled[b],
+            expected=Expectations(single_chip=True),
+            platform=self._device.platform,
+            config={"program": "serve_predict", "bucket": b,
+                    "example_shape": list(self.example_shape)},
+        )
+
+    # -- batcher loop --------------------------------------------------------
+
+    def _loop(self) -> None:
+        inflight = None
+        while True:
+            reqs = self._form_batch()
+            staged = None
+            if reqs:
+                try:
+                    staged = (reqs, self._dispatch(reqs))
+                except Exception as e:  # noqa: BLE001 — a bad batch must
+                    # fail its own requests, not kill the batcher thread
+                    # (hanging every future ever submitted after it).
+                    for r in reqs:
+                        r.future.set_exception(e)
+            if inflight is not None:
+                self._complete(*inflight)
+            inflight = staged
+            if (
+                inflight is None
+                and self._stop_evt.is_set()
+                and self._q.empty()
+            ):
+                return
+
+    def _form_batch(self) -> "list[_Request] | None":
+        try:
+            req = self._q.get(timeout=self._poll_s)
+        except queue.Empty:
+            return None
+        reqs: list[_Request] = []
+        window_end = time.monotonic() + self._max_wait_s
+        while True:
+            if time.monotonic() > req.deadline:
+                self._reject_deadline(req)
+            else:
+                reqs.append(req)
+            if len(reqs) >= self._max_batch:
+                break
+            timeout = window_end - time.monotonic()
+            if timeout <= 0:
+                break
+            try:
+                req = self._q.get(timeout=timeout)
+            except queue.Empty:
+                break
+        return reqs or None
+
+    def _dispatch(self, reqs: "list[_Request]"):
+        import jax
+
+        bucket = bucket_for(len(reqs), self._buckets)
+        # The executable must pre-exist — never compile on a live request.
+        if bucket not in self._compiled:
+            raise AssertionError(
+                f"no pre-built executable for bucket {bucket}"
+            )
+        batch = pad_batch([r.x for r in reqs], bucket, self._np_dtype)
+        staged = jax.device_put(batch, self._device)  # async H2D
+        return self._compiled[bucket](self._params, self._stats, staged)
+
+    def _complete(self, reqs: "list[_Request]", out) -> None:
+        logits = np.asarray(out)  # blocks until the device batch finishes
+        now = time.monotonic()
+        with self._lock:
+            self._counts["batches"] += 1
+            self._counts["batched_examples"] += len(reqs)
+        for i, r in enumerate(reqs):
+            if now > r.deadline:
+                with self._lock:
+                    self._counts["served_late"] += 1
+                r.future.set_exception(DeadlineExceededError(
+                    f"result ready {now - r.deadline:.3f}s past deadline — "
+                    "dropped rather than silently served late"
+                ))
+                continue
+            with self._lock:
+                self._counts["served"] += 1
+                self._latencies.append(now - r.submit_t)
+            r.future.set_result(logits[i])
+
+    def _reject_deadline(self, req: _Request) -> None:
+        with self._lock:
+            self._counts["rejected_deadline"] += 1
+        req.future.set_exception(DeadlineExceededError(
+            "deadline expired while the request waited for batch formation"
+        ))
+
+    def _flush_queue(self, msg: str) -> None:
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            req.future.set_exception(RuntimeError(msg))

@@ -1,0 +1,192 @@
+"""Closed/open-loop load generation against a :class:`ServingEngine`.
+
+Two standard load models (the serving-benchmark split popularized by
+ycsb/mlperf-inference):
+
+- **closed loop** — ``concurrency`` synthetic clients, each submitting its
+  next request the moment the previous one resolves. Measures achievable
+  throughput at a fixed concurrency; offered load self-regulates.
+- **open loop** — requests arrive on a fixed-rate clock regardless of
+  completions (the "millions of users" shape: arrivals don't wait for your
+  tail). Overload shows up as queue-full rejections and deadline misses
+  instead of silently stretching the measurement.
+
+Both produce one JSON-serializable report with tail percentiles
+(p50/p90/p99 — the numbers serving is judged by) and the engine's own
+counter snapshot. :func:`serial_throughput` is the batch-size-1 baseline
+the dynamic-batching win is measured against.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from mpi4dl_tpu.profiling import percentiles
+from mpi4dl_tpu.serve.engine import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServingEngine,
+)
+
+
+def _default_example(engine: ServingEngine):
+    rng = np.random.default_rng(0)
+
+    def make(i: int) -> np.ndarray:
+        del i
+        return rng.standard_normal(engine.example_shape).astype(
+            engine._np_dtype
+        )
+
+    return make
+
+
+def serial_throughput(
+    engine: ServingEngine, num_requests: int, make_example=None
+) -> dict:
+    """Requests served one at a time, batch size 1, synchronously — the
+    no-batching baseline (requests/sec == images/sec)."""
+    make_example = make_example or _default_example(engine)
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(num_requests):
+        s = time.perf_counter()
+        engine.predict_one(make_example(i))
+        lat.append(time.perf_counter() - s)
+    dt = time.perf_counter() - t0
+    return {
+        "mode": "serial_bs1",
+        "requests": num_requests,
+        "duration_s": dt,
+        "throughput_rps": num_requests / dt,
+        "latency_s": {**percentiles(lat), "mean": float(np.mean(lat))},
+    }
+
+
+class _Tally:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list[float] = []
+        self.served = 0
+        self.rejected_queue_full = 0
+        self.deadline_misses = 0
+        self.errors = 0
+
+    def resolve(self, future, t_submit: float) -> None:
+        try:
+            future.result()
+        except DeadlineExceededError:
+            with self.lock:
+                self.deadline_misses += 1
+            return
+        except Exception:  # noqa: BLE001 — tallied, surfaced in the report
+            with self.lock:
+                self.errors += 1
+            return
+        with self.lock:
+            self.served += 1
+            self.latencies.append(time.monotonic() - t_submit)
+
+
+def run_closed_loop(
+    engine: ServingEngine,
+    num_requests: int,
+    concurrency: int = 8,
+    deadline_s: float = 10.0,
+    make_example=None,
+) -> dict:
+    """``concurrency`` clients ping-ponging until ``num_requests`` total
+    have been submitted. High concurrency >> max batch keeps the queue
+    deep enough that the engine forms full buckets — the regime where
+    dynamic batching must beat serial bs-1 throughput."""
+    make_example = make_example or _default_example(engine)
+    tally = _Tally()
+    ticket = iter(range(num_requests))
+    ticket_lock = threading.Lock()
+
+    def client():
+        while True:
+            with ticket_lock:
+                i = next(ticket, None)
+            if i is None:
+                return
+            t = time.monotonic()
+            try:
+                fut = engine.submit(make_example(i), deadline_s=deadline_s)
+            except QueueFullError:
+                with tally.lock:
+                    tally.rejected_queue_full += 1
+                continue
+            tally.resolve(fut, t)
+
+    threads = [threading.Thread(target=client) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    dt = time.perf_counter() - t0
+    return _report("closed", num_requests, dt, tally, engine,
+                   concurrency=concurrency, deadline_s=deadline_s)
+
+
+def run_open_loop(
+    engine: ServingEngine,
+    rate_rps: float,
+    duration_s: float,
+    deadline_s: float = 10.0,
+    make_example=None,
+) -> dict:
+    """Fixed-rate arrivals for ``duration_s`` seconds; completions are
+    collected by worker threads so a slow tail never throttles arrivals."""
+    make_example = make_example or _default_example(engine)
+    tally = _Tally()
+    waiters: list[threading.Thread] = []
+    period = 1.0 / rate_rps
+    n = 0
+    t0 = time.perf_counter()
+    start = time.monotonic()
+    while time.perf_counter() - t0 < duration_s:
+        target = start + n * period
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = time.monotonic()
+        n += 1
+        try:
+            fut = engine.submit(make_example(n), deadline_s=deadline_s)
+        except QueueFullError:
+            with tally.lock:
+                tally.rejected_queue_full += 1
+            continue
+        w = threading.Thread(target=tally.resolve, args=(fut, t))
+        w.start()
+        waiters.append(w)
+    for w in waiters:
+        w.join()
+    dt = time.perf_counter() - t0
+    return _report("open", n, dt, tally, engine,
+                   rate_rps=rate_rps, deadline_s=deadline_s)
+
+
+def _report(mode, offered, dt, tally: _Tally, engine, **extra) -> dict:
+    lat = tally.latencies
+    return {
+        "mode": mode,
+        "offered": offered,
+        "served": tally.served,
+        "rejected_queue_full": tally.rejected_queue_full,
+        "deadline_misses": tally.deadline_misses,
+        "errors": tally.errors,
+        "duration_s": dt,
+        "throughput_rps": tally.served / dt if dt > 0 else 0.0,
+        "latency_s": {
+            **percentiles(lat),
+            "mean": float(np.mean(lat)) if lat else None,
+        },
+        "engine": engine.stats(),
+        **extra,
+    }

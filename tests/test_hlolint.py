@@ -145,6 +145,38 @@ def test_pure_dp_rule_flags_resharding():
     )  # the collective-permute is illegal in a pure-DP program
 
 
+def test_single_chip_rule_flags_any_collective():
+    # The serving gate: a single-chip program may not communicate at all —
+    # even the all-reduce that pure_dp would bless is an error here.
+    report = analyze_hlo_text(
+        OVERLAPPED, expected=Expectations(single_chip=True)
+    )
+    assert any(
+        f["rule"] == "single-chip-collectives" and f["severity"] == "error"
+        for f in report.findings
+    )
+    # "all-reduce" and "collective-permute" both named in the message.
+    msg = next(
+        f["message"] for f in report.findings
+        if f["rule"] == "single-chip-collectives"
+    )
+    assert "all-reduce" in msg and "collective-permute" in msg
+
+
+def test_single_chip_rule_passes_collective_free_hlo():
+    clean = """\
+HloModule clean, is_scheduled=true
+
+ENTRY %main.1 (p0: f32[8,128]) -> f32[8,128] {
+  ROOT %p0 = f32[8,128]{1,0} parameter(0)
+}
+"""
+    report = analyze_hlo_text(clean, expected=Expectations(single_chip=True))
+    assert not any(
+        f["rule"] == "single-chip-collectives" for f in report.findings
+    )
+
+
 def test_halo_permute_window():
     # OVERLAPPED has exactly 1 collective-permute.
     ok = analyze_hlo_text(OVERLAPPED, expected=Expectations(halo_shifts=1))
