@@ -62,6 +62,8 @@ AMOEBA_BASELINE = {  # img/s (BASELINE.md chart reads)
 _T0 = time.monotonic()
 _RESULT: dict = {}  # latest complete result; emitted incrementally
 _LAST_RUN: dict = {}  # trainer/state/batch of the last successful measurement
+_REGISTRY = None  # telemetry.MetricsRegistry, created in main()
+_TELEMETRY_LOG = None  # telemetry.JsonlWriter (MPI4DL_TPU_TELEMETRY_DIR)
 
 
 @functools.lru_cache(maxsize=1)
@@ -185,9 +187,20 @@ def sentinel_skip_reason(
 
 
 def _emit():
-    """Print the current result as one flushed JSON line (see module doc)."""
-    if _RESULT:
-        print(json.dumps(_RESULT), flush=True)
+    """Print the current result as one flushed JSON line (see module doc).
+    Each line carries a ``telemetry`` snapshot in the JSONL metrics-event
+    schema (mpi4dl_tpu.telemetry.jsonl), so BENCH_*.json records and the
+    MPI4DL_TPU_TELEMETRY_DIR event log stay one schema."""
+    if not _RESULT:
+        return
+    if _REGISTRY is not None and _REGISTRY.names():
+        from mpi4dl_tpu import telemetry
+
+        ev = telemetry.metrics_event(_REGISTRY)
+        _RESULT["telemetry"] = ev
+        if _TELEMETRY_LOG is not None:
+            _TELEMETRY_LOG.write(ev)
+    print(json.dumps(_RESULT), flush=True)
 
 
 def _on_signal(signum, frame):  # noqa: ARG001
@@ -291,12 +304,14 @@ def _train_throughput(
     # read costs one D2H round trip per multi-second step (<1% here) and
     # only tightens the measurement: dispatch pipelining can no longer
     # smear one slow step across its neighbors.
-    timer = StepTimer(batch_size=batch, warmup=0)
+    timer = StepTimer(batch_size=batch, warmup=0, registry=_REGISTRY)
     for _ in range(steps):
         with timer.step():
             state, metrics = trainer.train_step(state, xs, ys)
             float(metrics["loss"])
     dt = sum(timer.times)
+    if _REGISTRY is not None:
+        trainer.publish_telemetry(_REGISTRY)
     # Stash the measured program for the post-headline static analysis
     # (mpi4dl_tpu.analysis): re-lowering it is a warm-cache no-op.
     _LAST_RUN.update(trainer=trainer, state=state, xs=xs, ys=ys)
@@ -342,7 +357,7 @@ def _measure_serving() -> dict:
     engine = ServingEngine(
         cells, params, stats, example_shape=(size, size, 3),
         buckets=(1, 32), max_wait_s=0.003, max_queue=512,
-        default_deadline_s=30.0,
+        default_deadline_s=30.0, registry=_REGISTRY,
     )
     serial = serial_throughput(engine, 32)
     engine.start()
@@ -394,7 +409,12 @@ def _hlo_overlap_metrics() -> "dict | None":
             compiled,
             remat=tr.remat_report(),
             platform=jax.devices()[0].platform,
+            config={"program": "train_step"},
         )
+        if _REGISTRY is not None:
+            from mpi4dl_tpu.analysis.metrics import publish_report
+
+            publish_report(rep, _REGISTRY)
         return {
             "inventory": {k: v for k, v in rep.inventory.items() if v},
             "total_collective_bytes": rep.overlap["total_bytes"],
@@ -424,6 +444,12 @@ def main():
     apply_platform_env()  # honor JAX_PLATFORMS even under the axon plugin
     enable_compilation_cache()  # warm-cache compiles make the suite fit any
     # driver budget (first-ever run still pays them; the budget skips extras)
+
+    from mpi4dl_tpu import telemetry
+
+    global _REGISTRY, _TELEMETRY_LOG
+    _REGISTRY = telemetry.MetricsRegistry()
+    _TELEMETRY_LOG = telemetry.JsonlWriter()  # MPI4DL_TPU_TELEMETRY_DIR-gated
 
     import jax
     import jax.numpy as jnp

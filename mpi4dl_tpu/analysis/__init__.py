@@ -37,6 +37,7 @@ from mpi4dl_tpu.analysis.inventory import (  # noqa: F401
     overlap_summary,
 )
 from mpi4dl_tpu.analysis.memory import memory_summary  # noqa: F401
+from mpi4dl_tpu.analysis.metrics import publish_report  # noqa: F401
 from mpi4dl_tpu.analysis.report import (  # noqa: F401
     Report,
     analyze_compiled,

@@ -14,7 +14,17 @@ equivalents:
   and the serving load generator;
 - :func:`trace` — ``jax.profiler`` trace context writing a TensorBoard/XProf
   trace directory (device timelines, HLO cost, ICI collectives); enabled by
-  path or the ``MPI4DL_TPU_TRACE_DIR`` env var, no-op otherwise.
+  path or the ``MPI4DL_TPU_TRACE_DIR`` env var, no-op otherwise;
+- :func:`annotate_step` — ``jax.profiler.StepTraceAnnotation`` wrapper the
+  train/serve dispatch paths use, so XProf step boundaries carry the same
+  step/batch ids as the telemetry span log
+  (:mod:`mpi4dl_tpu.telemetry.spans`) and the two can be joined.
+
+:class:`StepTimer` optionally publishes into a telemetry registry
+(:mod:`mpi4dl_tpu.telemetry`): per-step ``train_step_seconds`` histogram
+observations, a ``train_steps_total`` counter, and a
+``train_images_per_sec`` gauge — the training side of the unified metric
+catalog (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -45,21 +55,35 @@ def percentiles(values, pcts=(50, 90, 99)) -> dict:
 class StepTimer:
     """Times steps and accumulates throughput stats.
 
-    Usage::
+    ``step()`` takes no argument — the context target (``as rec``) IS the
+    setter for the result to block on::
 
         timer = StepTimer(batch_size=B, warmup=1)
         for ... :
-            with timer.step(result_to_block_on_setter) as rec:
+            with timer.step() as rec:
                 state, metrics = trainer.train_step(...)
                 rec(metrics)           # anything with .block_until_ready leaves
         print(timer.summary())
+
+    ``registry``: an optional :class:`mpi4dl_tpu.telemetry.MetricsRegistry`;
+    each post-warmup step then also lands in the cataloged ``train_*``
+    metrics (histogram + counter + throughput gauge).
     """
 
-    def __init__(self, batch_size: int, warmup: int = 1):
+    def __init__(self, batch_size: int, warmup: int = 1, registry=None):
         self.batch_size = batch_size
         self.warmup = warmup
         self.times: list[float] = []
         self._seen = 0
+        self._metrics = None
+        if registry is not None:
+            from mpi4dl_tpu import telemetry
+
+            self._metrics = (
+                telemetry.declare(registry, "train_step_seconds"),
+                telemetry.declare(registry, "train_steps_total"),
+                telemetry.declare(registry, "train_images_per_sec"),
+            )
 
     @contextlib.contextmanager
     def step(self):
@@ -74,6 +98,11 @@ class StepTimer:
         self._seen += 1
         if self._seen > self.warmup:
             self.times.append(dt)
+            if self._metrics is not None:
+                hist, total, ips = self._metrics
+                hist.observe(dt)
+                total.inc()
+                ips.set(self.batch_size / dt if dt > 0 else 0.0)
 
     @property
     def images_per_sec(self) -> list[float]:
@@ -107,3 +136,25 @@ def trace(logdir: str | None = None):
 
     with jax.profiler.trace(logdir):
         yield logdir
+
+
+@contextlib.contextmanager
+def annotate_step(name: str, step: "int | None" = None):
+    """``jax.profiler.StepTraceAnnotation`` around one dispatch, so XProf
+    traces (:func:`trace`) slice the device timeline at the same step ids
+    the telemetry span log records. Host-side step counters (not device
+    arrays) only — reading a traced scalar here would force a sync.
+    Degrades to a no-op if the profiler annotation API is unavailable."""
+    import jax
+
+    try:
+        ann = (
+            jax.profiler.StepTraceAnnotation(name, step_num=step)
+            if step is not None
+            else jax.profiler.StepTraceAnnotation(name)
+        )
+    except Exception:  # noqa: BLE001 — observability must not break dispatch
+        yield
+        return
+    with ann:
+        yield

@@ -19,8 +19,13 @@ Entry points:
 - :mod:`mpi4dl_tpu.serve.loadgen` — the load-generation library behind
   ``benchmarks/serving/`` and the bench.py serving hook.
 
+Fully instrumented through :mod:`mpi4dl_tpu.telemetry`: request-lifecycle
+spans, outcome/queue-depth/bucket-occupancy metrics, an opt-in Prometheus
+scrape endpoint (``metrics_port=`` / ``--metrics-port``) and JSONL span
+log (``MPI4DL_TPU_TELEMETRY_DIR``).
+
 See ``docs/SERVING.md`` for architecture, bucket policy, and deadline
-semantics.
+semantics; ``docs/OBSERVABILITY.md`` for the metric catalog.
 """
 
 from mpi4dl_tpu.serve.batching import (  # noqa: F401

@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch-size-1 serial baseline requests (0 skips)")
     p.add_argument("--lint", action="store_true",
                    help="hlolint the serving executable; fail on errors")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve a Prometheus /metrics endpoint on this "
+                        "port for the run (0 = ephemeral; the bound port "
+                        "is in the report and on stderr)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="write JSONL span/metrics events here "
+                        "(default: $MPI4DL_TPU_TELEMETRY_DIR, unset = off)")
     p.add_argument("--json", dest="json_out", default=None,
                    help="also write the report JSON here")
     return p
@@ -90,6 +97,7 @@ def _synthetic_engine(args):
         max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
         max_queue=args.max_queue,
         default_deadline_s=args.deadline_ms / 1e3,
+        metrics_port=args.metrics_port, telemetry_dir=args.telemetry_dir,
     )
 
 
@@ -112,6 +120,7 @@ def main(argv=None) -> int:
             args.ckpt, max_batch=args.max_batch,
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
             default_deadline_s=args.deadline_ms / 1e3,
+            metrics_port=args.metrics_port, telemetry_dir=args.telemetry_dir,
         )
     else:
         engine = _synthetic_engine(args)
@@ -121,6 +130,14 @@ def main(argv=None) -> int:
                  f"synthetic_resnet{args.depth}_{args.image_size}px",
         "buckets": list(engine.buckets),
     }
+    if engine.metrics_port is not None:
+        report["metrics_port"] = engine.metrics_port
+        # stderr, not stdout: the stdout protocol is "keep the last JSON
+        # line", and the scrape URL must be visible while the run is live.
+        print(
+            f"# metrics: http://127.0.0.1:{engine.metrics_port}/metrics",
+            file=sys.stderr, flush=True,
+        )
     if args.serial:
         report["serial"] = serial_throughput(engine, args.serial)
 

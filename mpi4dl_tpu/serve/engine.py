@@ -28,11 +28,24 @@ movement with compute, and never let the hot loop pay a compile):
 
 Thread model: clients call :meth:`submit` from any thread (it only touches
 the bounded queue); a single batcher thread owns all JAX dispatch.
+
+Telemetry (:mod:`mpi4dl_tpu.telemetry`, docs/OBSERVABILITY.md): every
+request's lifecycle is traced as contiguous spans — ``queue_wait`` →
+``batch_form`` → ``h2d_stage`` → ``device_compute`` — whose durations sum
+exactly to its end-to-end latency; outcomes, queue depth, per-bucket
+dispatch counts/occupancy, and the pad-waste ratio land in a metrics
+registry. ``metrics_port=`` serves the registry as a Prometheus scrape
+endpoint; ``telemetry_dir=`` (or ``MPI4DL_TPU_TELEMETRY_DIR``) appends
+span events to a JSONL log. Both are opt-in; the registry itself is
+always on (a few lock-guarded float adds per request — batched throughput
+measured flat within ±1.5% noise across all telemetry arms; the overhead
+table in docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import queue
 import threading
 import time
@@ -41,7 +54,8 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from mpi4dl_tpu.profiling import percentiles
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.profiling import annotate_step, percentiles
 from mpi4dl_tpu.serve.batching import bucket_for, pad_batch, power_of_two_buckets
 
 
@@ -59,6 +73,12 @@ class _Request:
     submit_t: float
     deadline: float
     future: Future
+    trace_id: str = ""
+    # Span boundaries (time.monotonic), filled in as the request moves:
+    # picked by the batch former / batch complete / staged+dispatched.
+    form_t: float = 0.0
+    formed_t: float = 0.0
+    staged_t: float = 0.0
 
 
 class ServingEngine:
@@ -73,6 +93,13 @@ class ServingEngine:
     max_wait_s: batch-formation window after the first queued request.
     max_queue: admission-control bound on waiting requests.
     default_deadline_s: per-request deadline when ``submit`` gives none.
+    registry: a shared :class:`telemetry.MetricsRegistry`; None creates a
+        private one (exposed as :attr:`registry`).
+    metrics_port: serve the registry as a Prometheus ``/metrics`` endpoint
+        on this port (0 = ephemeral; bound port on :attr:`metrics_port`).
+        None (default) starts no server.
+    telemetry_dir: JSONL span-event log directory; None falls back to
+        ``MPI4DL_TPU_TELEMETRY_DIR``, unset disables.
     """
 
     def __init__(
@@ -87,6 +114,9 @@ class ServingEngine:
         max_wait_s: float = 0.002,
         max_queue: int = 64,
         default_deadline_s: float = 1.0,
+        registry=None,
+        metrics_port: "int | None" = None,
+        telemetry_dir: "str | None" = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -139,6 +169,36 @@ class ServingEngine:
             "batched_examples": 0,
         }
         self._latencies: list[float] = []
+        self._bucket_dispatches: dict[int, int] = {b: 0 for b in self._buckets}
+        self._padded_rows = 0
+        self._total_rows = 0
+        self._batch_seq = 0
+
+        # -- telemetry surface (docs/OBSERVABILITY.md) ----------------------
+        self.registry = (
+            registry if registry is not None else telemetry.MetricsRegistry()
+        )
+        self._events = telemetry.JsonlWriter(telemetry_dir)
+        decl = lambda name: telemetry.declare(self.registry, name)  # noqa: E731
+        self._m_submitted = decl("serve_submitted_total")
+        self._m_requests = decl("serve_requests_total")
+        self._m_qdepth = decl("serve_queue_depth")
+        self._m_batches = decl("serve_batches_total")
+        self._m_occupancy = decl("serve_batch_occupancy")
+        self._m_pad_waste = decl("serve_pad_waste_ratio")
+        self._m_latency = decl("serve_request_latency_seconds")
+        self._m_spans = decl("serve_span_seconds")
+        self._m_qdepth.set(0)
+        warm = decl("serve_warm_latency_seconds")
+        for b, t in self.warm_latency_s.items():
+            warm.set(t, bucket=b)
+        self._server = (
+            telemetry.MetricsServer(self.registry, port=metrics_port)
+            if metrics_port is not None
+            else None
+        )
+        self.metrics_port = self._server.port if self._server else None
+        self._req_seq = itertools.count()
 
     # -- construction helpers ------------------------------------------------
 
@@ -199,6 +259,12 @@ class ServingEngine:
             self._thread.join()
             self._thread = None
         self._flush_queue("engine stopped before this request was served")
+        # The exporters die with the engine; the registry itself stays
+        # readable (stats(), snapshots) after stop.
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        self._events.close()
 
     def submit(self, x, deadline_s: float | None = None) -> Future:
         """Enqueue one example; returns a ``Future`` resolving to its
@@ -216,17 +282,23 @@ class ServingEngine:
         ddl = now + (
             deadline_s if deadline_s is not None else self._default_deadline_s
         )
-        req = _Request(x=x, submit_t=now, deadline=ddl, future=Future())
+        req = _Request(
+            x=x, submit_t=now, deadline=ddl, future=Future(),
+            trace_id=telemetry.new_trace_id(f"serve-{next(self._req_seq)}"),
+        )
         with self._lock:
             self._counts["submitted"] += 1
+        self._m_submitted.inc()
         try:
             self._q.put_nowait(req)
         except queue.Full:
             with self._lock:
                 self._counts["rejected_queue_full"] += 1
+            self._m_requests.inc(outcome="rejected_queue_full")
             raise QueueFullError(
                 f"request queue full ({self._q.maxsize} waiting)"
             ) from None
+        self._m_qdepth.set(self._q.qsize())
         return req.future
 
     def predict_one(self, x) -> np.ndarray:
@@ -241,13 +313,19 @@ class ServingEngine:
         return np.asarray(out)[0]
 
     def stats(self) -> dict:
-        """Counter snapshot + served-latency percentiles (seconds)."""
+        """Counter snapshot + served-latency percentiles (seconds), plus
+        the live queue depth and per-bucket dispatch counts the autoscaling
+        signal consumes (mirrored in the metrics registry)."""
         with self._lock:
             out = dict(self._counts)
             lat = list(self._latencies)
+            out["bucket_dispatches"] = dict(self._bucket_dispatches)
+            padded, total = self._padded_rows, self._total_rows
         out["latency_s"] = percentiles(lat)
         if out["batches"]:
             out["mean_batch_size"] = out["batched_examples"] / out["batches"]
+        out["queue_depth"] = self._q.qsize()
+        out["pad_waste_ratio"] = padded / total if total else 0.0
         out["buckets"] = list(self._buckets)
         out["warm_latency_s"] = dict(self.warm_latency_s)
         return out
@@ -259,14 +337,18 @@ class ServingEngine:
         from mpi4dl_tpu.analysis import analyze_compiled
         from mpi4dl_tpu.analysis.rules import Expectations
 
+        from mpi4dl_tpu.analysis.metrics import publish_report
+
         b = bucket if bucket is not None else max(self._buckets)
-        return analyze_compiled(
+        rep = analyze_compiled(
             self._compiled[b],
             expected=Expectations(single_chip=True),
             platform=self._device.platform,
             config={"program": "serve_predict", "bucket": b,
                     "example_shape": list(self.example_shape)},
         )
+        publish_report(rep, self.registry)  # verdict scrapes with the rest
+        return rep
 
     # -- batcher loop --------------------------------------------------------
 
@@ -301,7 +383,8 @@ class ServingEngine:
         reqs: list[_Request] = []
         window_end = time.monotonic() + self._max_wait_s
         while True:
-            if time.monotonic() > req.deadline:
+            req.form_t = time.monotonic()  # queue_wait ends at the pop
+            if req.form_t > req.deadline:
                 self._reject_deadline(req)
             else:
                 reqs.append(req)
@@ -314,6 +397,11 @@ class ServingEngine:
                 req = self._q.get(timeout=timeout)
             except queue.Empty:
                 break
+        self._m_qdepth.set(self._q.qsize())
+        if reqs:
+            formed = time.monotonic()
+            for r in reqs:
+                r.formed_t = formed
         return reqs or None
 
     def _dispatch(self, reqs: "list[_Request]"):
@@ -326,12 +414,30 @@ class ServingEngine:
                 f"no pre-built executable for bucket {bucket}"
             )
         batch = pad_batch([r.x for r in reqs], bucket, self._np_dtype)
-        staged = jax.device_put(batch, self._device)  # async H2D
-        return self._compiled[bucket](self._params, self._stats, staged)
+        seq = self._batch_seq
+        self._batch_seq += 1
+        with annotate_step("mpi4dl_serve_batch", seq):
+            staged = jax.device_put(batch, self._device)  # async H2D
+            out = self._compiled[bucket](self._params, self._stats, staged)
+        staged_t = time.monotonic()
+        for r in reqs:
+            r.staged_t = staged_t
+        with self._lock:
+            self._bucket_dispatches[bucket] = (
+                self._bucket_dispatches.get(bucket, 0) + 1
+            )
+            self._padded_rows += bucket - len(reqs)
+            self._total_rows += bucket
+            waste = self._padded_rows / self._total_rows
+        self._m_batches.inc(bucket=bucket)
+        self._m_occupancy.observe(len(reqs) / bucket, bucket=bucket)
+        self._m_pad_waste.set(waste)
+        return out
 
     def _complete(self, reqs: "list[_Request]", out) -> None:
         logits = np.asarray(out)  # blocks until the device batch finishes
         now = time.monotonic()
+        bucket = bucket_for(len(reqs), self._buckets)
         with self._lock:
             self._counts["batches"] += 1
             self._counts["batched_examples"] += len(reqs)
@@ -339,6 +445,8 @@ class ServingEngine:
             if now > r.deadline:
                 with self._lock:
                     self._counts["served_late"] += 1
+                self._m_requests.inc(outcome="served_late")
+                self._emit_spans(r, now, "served_late", bucket, len(reqs))
                 r.future.set_exception(DeadlineExceededError(
                     f"result ready {now - r.deadline:.3f}s past deadline — "
                     "dropped rather than silently served late"
@@ -347,11 +455,48 @@ class ServingEngine:
             with self._lock:
                 self._counts["served"] += 1
                 self._latencies.append(now - r.submit_t)
+            self._m_requests.inc(outcome="served")
+            self._m_latency.observe(now - r.submit_t)
+            self._emit_spans(r, now, "served", bucket, len(reqs))
             r.future.set_result(logits[i])
+
+    def _emit_spans(
+        self, r: _Request, end_t: float, outcome: str,
+        bucket: int, batch_size: int,
+    ) -> None:
+        """Record one request's contiguous lifecycle spans: into the
+        phase-labeled histogram always, into the JSONL log when enabled.
+        Contiguity (each phase starts where the previous ended, the last
+        ends at delivery) is what makes queue+form+stage+compute sum to
+        the end-to-end latency — the tier-1 invariant."""
+        spans = telemetry.spans_from_marks([
+            ("submit", r.submit_t),
+            ("queue_wait", r.form_t),
+            ("batch_form", r.formed_t),
+            ("h2d_stage", r.staged_t),
+            ("device_compute", end_t),
+        ])
+        telemetry.record_spans(self._m_spans, spans)
+        if self._events.enabled:
+            self._events.write(telemetry.span_event(
+                "serve.request", r.trace_id, spans,
+                attrs={"outcome": outcome, "bucket": bucket,
+                       "batch_size": batch_size,
+                       "e2e_latency_s": end_t - r.submit_t},
+            ))
 
     def _reject_deadline(self, req: _Request) -> None:
         with self._lock:
             self._counts["rejected_deadline"] += 1
+        self._m_requests.inc(outcome="rejected_deadline")
+        if self._events.enabled:
+            spans = telemetry.spans_from_marks([
+                ("submit", req.submit_t), ("queue_wait", req.form_t),
+            ])
+            self._events.write(telemetry.span_event(
+                "serve.request", req.trace_id, spans,
+                attrs={"outcome": "rejected_deadline"},
+            ))
         req.future.set_exception(DeadlineExceededError(
             "deadline expired while the request waited for batch formation"
         ))

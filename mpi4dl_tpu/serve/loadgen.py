@@ -15,6 +15,12 @@ Both produce one JSON-serializable report with tail percentiles
 (p50/p90/p99 — the numbers serving is judged by) and the engine's own
 counter snapshot. :func:`serial_throughput` is the batch-size-1 baseline
 the dynamic-batching win is measured against.
+
+Client-observed outcomes and latency also land in a telemetry registry
+(``loadgen_*`` metrics, docs/OBSERVABILITY.md) — by default the engine's
+own :attr:`ServingEngine.registry`, so one Prometheus scrape of
+``--metrics-port`` shows the server-side spans AND the client-side view
+they must reconcile with.
 """
 
 from __future__ import annotations
@@ -67,13 +73,32 @@ def serial_throughput(
 
 
 class _Tally:
-    def __init__(self):
+    def __init__(self, registry=None):
         self.lock = threading.Lock()
         self.latencies: list[float] = []
         self.served = 0
         self.rejected_queue_full = 0
         self.deadline_misses = 0
         self.errors = 0
+        self._m_requests = self._m_latency = None
+        if registry is not None:
+            from mpi4dl_tpu import telemetry
+
+            self._m_requests = telemetry.declare(
+                registry, "loadgen_requests_total"
+            )
+            self._m_latency = telemetry.declare(
+                registry, "loadgen_request_latency_seconds"
+            )
+
+    def _count(self, outcome: str) -> None:
+        if self._m_requests is not None:
+            self._m_requests.inc(outcome=outcome)
+
+    def reject(self) -> None:
+        with self.lock:
+            self.rejected_queue_full += 1
+        self._count("rejected_queue_full")
 
     def resolve(self, future, t_submit: float) -> None:
         try:
@@ -81,14 +106,20 @@ class _Tally:
         except DeadlineExceededError:
             with self.lock:
                 self.deadline_misses += 1
+            self._count("deadline_miss")
             return
         except Exception:  # noqa: BLE001 — tallied, surfaced in the report
             with self.lock:
                 self.errors += 1
+            self._count("error")
             return
+        lat = time.monotonic() - t_submit
         with self.lock:
             self.served += 1
-            self.latencies.append(time.monotonic() - t_submit)
+            self.latencies.append(lat)
+        self._count("served")
+        if self._m_latency is not None:
+            self._m_latency.observe(lat)
 
 
 def run_closed_loop(
@@ -97,13 +128,16 @@ def run_closed_loop(
     concurrency: int = 8,
     deadline_s: float = 10.0,
     make_example=None,
+    registry=None,
 ) -> dict:
     """``concurrency`` clients ping-ponging until ``num_requests`` total
     have been submitted. High concurrency >> max batch keeps the queue
     deep enough that the engine forms full buckets — the regime where
-    dynamic batching must beat serial bs-1 throughput."""
+    dynamic batching must beat serial bs-1 throughput. ``registry``
+    defaults to the engine's own, so client-side metrics share its scrape
+    endpoint."""
     make_example = make_example or _default_example(engine)
-    tally = _Tally()
+    tally = _Tally(registry if registry is not None else engine.registry)
     ticket = iter(range(num_requests))
     ticket_lock = threading.Lock()
 
@@ -117,8 +151,7 @@ def run_closed_loop(
             try:
                 fut = engine.submit(make_example(i), deadline_s=deadline_s)
             except QueueFullError:
-                with tally.lock:
-                    tally.rejected_queue_full += 1
+                tally.reject()
                 continue
             tally.resolve(fut, t)
 
@@ -139,11 +172,12 @@ def run_open_loop(
     duration_s: float,
     deadline_s: float = 10.0,
     make_example=None,
+    registry=None,
 ) -> dict:
     """Fixed-rate arrivals for ``duration_s`` seconds; completions are
     collected by worker threads so a slow tail never throttles arrivals."""
     make_example = make_example or _default_example(engine)
-    tally = _Tally()
+    tally = _Tally(registry if registry is not None else engine.registry)
     waiters: list[threading.Thread] = []
     period = 1.0 / rate_rps
     n = 0
@@ -159,8 +193,7 @@ def run_open_loop(
         try:
             fut = engine.submit(make_example(n), deadline_s=deadline_s)
         except QueueFullError:
-            with tally.lock:
-                tally.rejected_queue_full += 1
+            tally.reject()
             continue
         w = threading.Thread(target=tally.resolve, args=(fut, t))
         w.start()

@@ -1,0 +1,75 @@
+"""Unified telemetry: metrics registry, request-span tracing, exporters.
+
+The cross-cutting observability layer the ROADMAP's serving follow-ons
+(autoscaling signals, continuous batching, multi-chip serving) read their
+signals from. Four pieces:
+
+- :mod:`.registry` — threadsafe counters/gauges/histograms with streaming
+  reservoirs (percentiles via the shared
+  :func:`mpi4dl_tpu.profiling.percentiles`);
+- :mod:`.spans` — per-request lifecycle spans whose phase durations sum
+  exactly to end-to-end latency;
+- :mod:`.export` — Prometheus text format + stdlib ``http.server`` scrape
+  endpoint (``ServingEngine(metrics_port=...)`` /
+  ``python -m mpi4dl_tpu.serve --metrics-port``);
+- :mod:`.jsonl` — schema-validated JSONL event log
+  (``MPI4DL_TPU_TELEMETRY_DIR``), the same snapshot schema bench.py
+  embeds in its result lines;
+- :mod:`.catalog` — the single source of truth for metric names/types/
+  labels; publishers go through :func:`declare`, and tier-1 tests pin the
+  catalog against both ``docs/OBSERVABILITY.md`` and what a full-stack
+  run actually exposes.
+
+Who publishes what: ``serve.ServingEngine`` (request outcomes, queue
+depth, bucket occupancy, pad waste, latency + lifecycle spans),
+``serve.loadgen`` (client-observed outcomes/latency),
+``profiling.StepTimer`` (step-time histogram/throughput),
+``train.Trainer.publish_telemetry`` (remat/halo facts),
+``analysis.publish_report`` (hlolint verdicts). See
+``docs/OBSERVABILITY.md`` for the full metric catalog and examples.
+"""
+
+import threading
+
+from mpi4dl_tpu.telemetry.catalog import (  # noqa: F401
+    CATALOG,
+    MetricSpec,
+    declare,
+)
+from mpi4dl_tpu.telemetry.export import (  # noqa: F401
+    MetricsServer,
+    render_prometheus,
+)
+from mpi4dl_tpu.telemetry.jsonl import (  # noqa: F401
+    ENV_DIR,
+    JsonlWriter,
+    metrics_event,
+    read_events,
+    validate_event,
+)
+from mpi4dl_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Reservoir,
+)
+from mpi4dl_tpu.telemetry.spans import (  # noqa: F401
+    new_trace_id,
+    record_spans,
+    span_event,
+    spans_from_marks,
+)
+
+_default_registry: "MetricsRegistry | None" = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The lazily-created process-wide registry, for publishers not handed
+    an explicit one (``Trainer.publish_telemetry()`` with no argument)."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry

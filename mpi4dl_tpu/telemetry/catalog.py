@@ -1,0 +1,165 @@
+"""The metric catalog: every metric this codebase publishes, in one place.
+
+Publishers do not call ``registry.counter(...)`` with ad-hoc strings — they
+call :func:`declare`, which looks the name up here and registers it with
+the cataloged type/labels/help. That makes the catalog load-bearing rather
+than aspirational: code physically cannot publish an uncataloged name
+through :func:`declare`, and the tier-1 test
+(``tests/test_telemetry.py``) closes the loop in both directions —
+
+- the metric table in ``docs/OBSERVABILITY.md`` must list exactly these
+  names/types/labels (no silently undocumented metrics), and
+- a full-stack exercise (serving engine + load generator + trainer +
+  hlolint publish) must expose exactly these names (no stale catalog
+  entries for metrics nothing publishes anymore).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from mpi4dl_tpu.telemetry.registry import DEFAULT_BUCKETS, MetricsRegistry
+
+# Bucket-occupancy is a ratio in (0, 1]; latency buckets would waste every
+# bound above 1.
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    type: str  # "counter" | "gauge" | "histogram"
+    labels: tuple
+    help: str
+    buckets: "tuple | None" = None  # histograms only; None = DEFAULT_BUCKETS
+
+
+CATALOG: "dict[str, MetricSpec]" = {
+    # -- serving engine (mpi4dl_tpu/serve/engine.py) -------------------------
+    "serve_submitted_total": MetricSpec(
+        "counter", (),
+        "Requests accepted into the bounded queue by submit().",
+    ),
+    "serve_requests_total": MetricSpec(
+        "counter", ("outcome",),
+        "Terminal request outcomes: served, served_late, "
+        "rejected_queue_full, rejected_deadline.",
+    ),
+    "serve_queue_depth": MetricSpec(
+        "gauge", (),
+        "Requests currently waiting in the bounded queue (the "
+        "load-shedding / scale-up signal a fleet controller consumes).",
+    ),
+    "serve_batches_total": MetricSpec(
+        "counter", ("bucket",),
+        "Batches dispatched, by padded bucket size.",
+    ),
+    "serve_batch_occupancy": MetricSpec(
+        "histogram", ("bucket",),
+        "Real examples / bucket rows per dispatched batch (1.0 = no "
+        "padding), by bucket.",
+        buckets=OCCUPANCY_BUCKETS,
+    ),
+    "serve_pad_waste_ratio": MetricSpec(
+        "gauge", (),
+        "Cumulative padded rows / total dispatched rows — compute wasted "
+        "on padding.",
+    ),
+    "serve_request_latency_seconds": MetricSpec(
+        "histogram", (),
+        "End-to-end latency of served requests (submit -> result ready).",
+    ),
+    "serve_span_seconds": MetricSpec(
+        "histogram", ("phase",),
+        "Per-request lifecycle span durations: queue_wait, batch_form, "
+        "h2d_stage, device_compute. Contiguous: they sum to the "
+        "end-to-end latency.",
+    ),
+    "serve_warm_latency_seconds": MetricSpec(
+        "gauge", ("bucket",),
+        "First post-compile execution latency per bucket, measured at "
+        "AOT warm-up.",
+    ),
+    # -- load generator (mpi4dl_tpu/serve/loadgen.py) ------------------------
+    "loadgen_requests_total": MetricSpec(
+        "counter", ("outcome",),
+        "Client-side request outcomes: served, rejected_queue_full, "
+        "deadline_miss, error.",
+    ),
+    "loadgen_request_latency_seconds": MetricSpec(
+        "histogram", (),
+        "Client-observed latency (submit call -> future resolved).",
+    ),
+    # -- training (mpi4dl_tpu/profiling.py StepTimer, train.py Trainer) ------
+    "train_step_seconds": MetricSpec(
+        "histogram", (),
+        "Wall-clock per train step, forced to full execution "
+        "(StepTimer's block-until-ready boundary).",
+    ),
+    "train_steps_total": MetricSpec(
+        "counter", (),
+        "Timed train steps (post-warmup).",
+    ),
+    "train_images_per_sec": MetricSpec(
+        "gauge", (),
+        "Throughput of the most recent timed step.",
+    ),
+    "train_remat_store_budget_mb": MetricSpec(
+        "gauge", (),
+        "Configured scanq/scan_save store budget (MPI4DL_TPU_SCANQ_"
+        "STORE_MB / save budget), from Trainer.remat_report().",
+    ),
+    "train_remat_granted_bytes": MetricSpec(
+        "gauge", (),
+        "Bytes of activations actually granted storage at the last trace "
+        "(Trainer.remat_report()).",
+    ),
+    "train_halo_shifts": MetricSpec(
+        "gauge", (),
+        "Forward halo-shift ppermutes per un-scanned pass "
+        "(Trainer.halo_shift_count) — the partition-math floor hlolint "
+        "checks the compiled inventory against.",
+    ),
+    # -- hlolint (mpi4dl_tpu/analysis/metrics.py) ----------------------------
+    "hlolint_ok": MetricSpec(
+        "gauge", ("program",),
+        "1 when the program's lint report has no error-severity findings.",
+    ),
+    "hlolint_findings": MetricSpec(
+        "gauge", ("program", "severity"),
+        "Finding count by severity in the latest lint report.",
+    ),
+    "hlolint_collectives": MetricSpec(
+        "gauge", ("program",),
+        "Collective ops in the compiled program.",
+    ),
+    "hlolint_collective_bytes": MetricSpec(
+        "gauge", ("program",),
+        "Bytes moved by collectives in the compiled program.",
+    ),
+    "hlolint_peak_hbm_bytes": MetricSpec(
+        "gauge", ("program",),
+        "Peak buffer-assignment bytes (argument + output + temp - alias) "
+        "of the compiled program; 0 when the backend cannot report it.",
+    ),
+}
+
+
+def declare(registry: MetricsRegistry, name: str):
+    """Register-or-fetch a cataloged metric on ``registry``. The only
+    sanctioned way for stack code to obtain a metric object — an
+    uncataloged name raises here, at the publisher, not in CI."""
+    spec = CATALOG.get(name)
+    if spec is None:
+        raise KeyError(
+            f"metric {name!r} is not in telemetry.catalog.CATALOG — add it "
+            "there (and to docs/OBSERVABILITY.md) before publishing it"
+        )
+    if spec.type == "counter":
+        return registry.counter(name, spec.help, spec.labels)
+    if spec.type == "gauge":
+        return registry.gauge(name, spec.help, spec.labels)
+    return registry.histogram(
+        name, spec.help, spec.labels,
+        buckets=spec.buckets if spec.buckets is not None else DEFAULT_BUCKETS,
+    )

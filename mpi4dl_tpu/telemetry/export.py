@@ -1,0 +1,131 @@
+"""Prometheus text exposition + the stdlib HTTP scrape endpoint.
+
+:func:`render_prometheus` serializes a :class:`MetricsRegistry` in the
+Prometheus text format (version 0.0.4): ``# HELP`` / ``# TYPE`` headers,
+escaped label values, and for histograms the cumulative ``_bucket{le=}``
+series plus ``_sum``/``_count``. :class:`MetricsServer` serves it from a
+daemon ``http.server`` thread — stdlib only (the container must not need
+``prometheus_client``), opt-in via ``ServingEngine(metrics_port=...)`` or
+``python -m mpi4dl_tpu.serve --metrics-port`` (port 0 binds an ephemeral
+port, reported back on :attr:`MetricsServer.port`).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mpi4dl_tpu.telemetry.registry import MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def escape_help(text: str) -> str:
+    r"""HELP-line escaping: backslash and newline."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def escape_label_value(text: str) -> str:
+    r"""Label-value escaping: backslash, double-quote, newline."""
+    return (
+        text.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _labels_str(labels: dict, extra: "dict | None" = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(str(v))}"' for k, v in merged.items()
+    )
+    return "{" + inner + "}"
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    lines: list[str] = []
+    for snap_name, m in registry.snapshot().items():
+        if m["help"]:
+            lines.append(f"# HELP {snap_name} {escape_help(m['help'])}")
+        lines.append(f"# TYPE {snap_name} {m['type']}")
+        for s in m["series"]:
+            if m["type"] == "histogram":
+                for le, cum in s["buckets"].items():
+                    lines.append(
+                        f"{snap_name}_bucket"
+                        f"{_labels_str(s['labels'], {'le': le})} {cum}"
+                    )
+                lines.append(
+                    f"{snap_name}_sum{_labels_str(s['labels'])} "
+                    f"{_fmt_value(s['sum'])}"
+                )
+                lines.append(
+                    f"{snap_name}_count{_labels_str(s['labels'])} "
+                    f"{s['count']}"
+                )
+            else:
+                lines.append(
+                    f"{snap_name}{_labels_str(s['labels'])} "
+                    f"{_fmt_value(s['value'])}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """``/metrics`` scrape endpoint on a daemon thread.
+
+    Binds immediately in the constructor (so an in-use port fails loudly at
+    startup, not on the first scrape); ``port=0`` picks an ephemeral port,
+    readable from :attr:`port`.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.registry = registry
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = render_prometheus(server.registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes must not spam stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mpi4dl-metrics-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

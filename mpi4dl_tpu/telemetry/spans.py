@@ -1,0 +1,89 @@
+"""Request-span tracing: contiguous lifecycle phases per request.
+
+A request's life in the serving engine is a chain of phases —
+``queue_wait`` (submit → picked by the batch former), ``batch_form``
+(picked → batch complete), ``h2d_stage`` (batch complete → host→device
+staging + dispatch issued), ``device_compute`` (dispatch → result ready on
+host). The engine records one monotonic timestamp at each boundary;
+:func:`spans_from_marks` turns the boundary list into span dicts whose
+durations sum EXACTLY to the end-to-end latency (each span starts where
+the previous one ends — an invariant the tier-1 tests assert on real
+JSONL logs, and the property that makes "where did my p99 go" answerable
+by subtraction).
+
+Span events are JSONL records (:mod:`mpi4dl_tpu.telemetry.jsonl`) keyed by
+a process-unique ``trace_id`` that :func:`mpi4dl_tpu.profiling.annotate_step`
+aligns with XProf step annotations, so a device-timeline trace and the
+host-side span log can be joined on the same ids.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+_counter = itertools.count()
+_counter_lock = threading.Lock()
+
+
+def new_trace_id(prefix: str = "req") -> str:
+    """Process-unique, monotonic, human-greppable trace id."""
+    with _counter_lock:
+        n = next(_counter)
+    return f"{prefix}-{os.getpid():x}-{n}"
+
+
+def spans_from_marks(marks: "list[tuple[str, float]]") -> "list[dict]":
+    """``[(label, t0), (phase1, t1), (phase2, t2), ...]`` → span dicts.
+
+    The first mark anchors the start; each subsequent ``(phase, t)`` closes
+    the phase ending at ``t``. Timestamps must be non-decreasing (a clock
+    that runs backwards would silently corrupt every duration downstream,
+    so it raises instead).
+    """
+    if len(marks) < 2:
+        raise ValueError("need an anchor mark plus at least one phase")
+    spans = []
+    prev = float(marks[0][1])
+    for phase, t in marks[1:]:
+        t = float(t)
+        if t < prev:
+            raise ValueError(
+                f"span {phase!r} ends at {t} before it starts at {prev}"
+            )
+        spans.append({
+            "phase": str(phase),
+            "start_s": prev,
+            "end_s": t,
+            "duration_s": t - prev,
+        })
+        prev = t
+    return spans
+
+
+def span_event(
+    name: str,
+    trace_id: str,
+    spans: "list[dict]",
+    attrs: "dict | None" = None,
+    ts: "float | None" = None,
+) -> dict:
+    """One JSONL span record (kind="span") — see jsonl.validate_event."""
+    return {
+        "ts": time.time() if ts is None else float(ts),
+        "kind": "span",
+        "name": str(name),
+        "trace_id": str(trace_id),
+        "spans": spans,
+        "attrs": dict(attrs or {}),
+    }
+
+
+def record_spans(histogram, spans: "list[dict]") -> None:
+    """Mirror span durations into a phase-labeled histogram (the catalog's
+    ``serve_span_seconds``) so the per-phase distribution is scrapeable
+    without replaying the JSONL log."""
+    for s in spans:
+        histogram.observe(s["duration_s"], phase=s["phase"])

@@ -284,6 +284,9 @@ class Trainer:
             donate_argnums=0,
             compiler_options=xla_compiler_options(),
         )
+        # Host-side step counter for XProf step annotation (profiling.
+        # annotate_step): reading state.step would force a device sync.
+        self._host_steps = 0
 
     # -- initialization ------------------------------------------------------
     def init(self, rng, sample_shape: Sequence[int], dtype=jnp.float32) -> TrainState:
@@ -982,6 +985,33 @@ class Trainer:
             jax.eval_shape(fn, params, x)
         return box[0]
 
+    def publish_telemetry(
+        self, registry=None, params=None, x_shape=None, dtype=jnp.float32
+    ):
+        """Publish the trainer's static facts as cataloged gauges
+        (docs/OBSERVABILITY.md): the remat policy's store budget and
+        granted bytes (:meth:`remat_report`), plus — when ``params`` and
+        ``x_shape`` are given — the forward halo-shift count
+        (:meth:`halo_shift_count`, an abstract trace; no device work).
+        ``registry=None`` uses the process-wide default. Step-time series
+        come from :class:`mpi4dl_tpu.profiling.StepTimer(registry=...)`,
+        not from here. Returns the registry."""
+        from mpi4dl_tpu import telemetry
+
+        reg = registry if registry is not None else telemetry.default_registry()
+        rep = self.remat_report()
+        telemetry.declare(reg, "train_remat_store_budget_mb").set(
+            rep["store_budget_mb"]
+        )
+        telemetry.declare(reg, "train_remat_granted_bytes").set(
+            rep["granted_bytes"]
+        )
+        if params is not None and x_shape is not None:
+            telemetry.declare(reg, "train_halo_shifts").set(
+                self.halo_shift_count(params, x_shape, dtype=dtype)
+            )
+        return reg
+
     def remat_report(self) -> dict:
         """Remat/store-budget metadata for the analyzer's effectiveness
         rule: the configured policy + scanq store budget, and the grant
@@ -1001,8 +1031,15 @@ class Trainer:
 
         from mpi4dl_tpu.ops import pool_pallas
         from mpi4dl_tpu.ops.fastconv import wgrad_taps_threshold
+        from mpi4dl_tpu.profiling import annotate_step
 
+        step_id = self._host_steps
+        self._host_steps += 1
         with ExitStack() as stack:
+            # XProf step boundary carrying the same host-side step id the
+            # telemetry layer records, so profiling.trace dumps align with
+            # StepTimer/span data (docs/OBSERVABILITY.md).
+            stack.enter_context(annotate_step("mpi4dl_train_step", step_id))
             if self.config.image_size >= 3072:
                 # Arm the aggressive per-tap wgrad gate for this trace:
                 # at these sizes the backward-filter conv's padded

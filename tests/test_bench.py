@@ -59,6 +59,13 @@ def test_amoebanet_headline_line_shape(cache_dir):
         assert r["metric"].startswith("amoebanetd_")
         assert isinstance(r["value"], (int, float)) and r["value"] > 0
         assert "vs_baseline" in r
+    # Result lines carry a registry snapshot in the JSONL metrics-event
+    # schema (docs/OBSERVABILITY.md) — validated with the same validator
+    # the event log enforces, and the train-side series must be populated.
+    from mpi4dl_tpu import telemetry
+
+    tele = telemetry.validate_event(records[-1]["telemetry"])
+    assert tele["metrics"]["train_steps_total"]["series"][0]["value"] > 0
 
 
 @pytest.mark.slow

@@ -153,6 +153,18 @@ def test_engine_serves_correct_results(model):
     assert s["served"] == 10
     assert s["batches"] >= 3  # max_batch=4 → at least ceil(10/4)
     assert set(s["latency_s"]) == {"p50", "p90", "p99"}
+    # Telemetry satellite: live queue depth + per-bucket dispatch counts
+    # (the autoscaling signal) are part of the stats surface.
+    assert s["queue_depth"] == 0  # everything drained
+    assert set(s["bucket_dispatches"]) == set(eng.buckets)
+    assert sum(s["bucket_dispatches"].values()) == s["batches"]
+    total_rows = sum(b * n for b, n in s["bucket_dispatches"].items())
+    padded_rows = total_rows - s["batched_examples"]
+    assert s["pad_waste_ratio"] == pytest.approx(padded_rows / total_rows)
+    # The registry mirrors the same counters (one source of truth).
+    assert eng.registry.get("serve_requests_total").value(
+        outcome="served"
+    ) == 10
 
 
 # -- deadlines + admission control -------------------------------------------
@@ -340,13 +352,14 @@ def test_loadgen_dynamic_batching_beats_serial(amoeba_engine):
 # -- CLI ---------------------------------------------------------------------
 
 
-def test_serve_cli_end_to_end(capsys):
+def test_serve_cli_end_to_end(capsys, tmp_path):
+    from mpi4dl_tpu import telemetry
     from mpi4dl_tpu.serve.__main__ import main
 
     rc = main([
         "--image-size", "16", "--depth", "11", "--max-batch", "4",
         "--requests", "24", "--concurrency", "8", "--serial", "8",
-        "--lint",
+        "--lint", "--metrics-port", "0", "--telemetry-dir", str(tmp_path),
     ])
     assert rc == 0
     line = [
@@ -357,3 +370,13 @@ def test_serve_cli_end_to_end(capsys):
     assert {"p50", "p90", "p99"} <= set(rep["loadgen"]["latency_s"])
     assert rep["lint"]["ok"]
     assert rep["serial"]["throughput_rps"] > 0
+    # Telemetry surface: the report names the bound scrape port, stats
+    # carry the registry-backed fields, and the JSONL span log landed.
+    assert isinstance(rep["metrics_port"], int)
+    assert rep["loadgen"]["engine"]["queue_depth"] == 0
+    (log,) = tmp_path.iterdir()
+    served = [
+        e for e in telemetry.read_events(str(log))
+        if e["kind"] == "span" and e["attrs"]["outcome"] == "served"
+    ]
+    assert len(served) == 24
