@@ -47,6 +47,7 @@ import json
 import os
 import signal
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -360,12 +361,25 @@ def _measure_serving() -> dict:
         default_deadline_s=30.0, registry=_REGISTRY,
     )
     serial = serial_throughput(engine, 32)
+    attribute = os.environ.get("BENCH_ATTRIBUTION", "1") != "0"
+    trace_dir = (
+        tempfile.mkdtemp(prefix="mpi4dl-bench-serve-trace-")
+        if attribute else None
+    )
     engine.start()
     try:
-        rep = run_closed_loop(engine, 384, concurrency=96, deadline_s=30.0)
+        from contextlib import nullcontext
+
+        from mpi4dl_tpu.profiling import trace as profiler_trace
+
+        with profiler_trace(trace_dir) if attribute else nullcontext():
+            rep = run_closed_loop(
+                engine, 384, concurrency=96, deadline_s=30.0
+            )
     finally:
         engine.stop()
     lint = engine.lint_report()
+    attribution = _serving_attribution(trace_dir, lint) if attribute else None
     entry = {
         "value": round(rep["throughput_rps"], 1),
         "serial_bs1_rps": round(serial["throughput_rps"], 1),
@@ -382,11 +396,47 @@ def _measure_serving() -> dict:
         "rejected": rep["rejected_queue_full"],
         "lint_ok": lint.ok,
     }
+    if attribution is not None:
+        entry["attribution"] = attribution
     if not lint.ok:
         entry["lint_findings"] = [
             f for f in lint.findings if f["severity"] == "error"
         ]
     return entry
+
+
+def _serving_attribution(trace_dir, lint_report) -> "dict | None":
+    """Measured device-time attribution of the serving load run
+    (analysis/trace.py over the engine's own ``mpi4dl_serve_batch``
+    annotations), cross-checked against the single-chip static lint.
+    Advisory: failures degrade to an error note. ``BENCH_ATTRIBUTION=0``
+    disables (checked by the caller, which then skips the trace too)."""
+    import shutil
+
+    try:
+        from mpi4dl_tpu.analysis.trace import (
+            analyze_trace_dir,
+            crosscheck_overlap,
+            publish_attribution,
+        )
+
+        summary = analyze_trace_dir(
+            trace_dir, step_name="mpi4dl_serve_batch"
+        )
+        if _REGISTRY is not None:
+            publish_attribution(summary, _REGISTRY, program="serve_batch")
+        checks = crosscheck_overlap(lint_report, summary)
+        return {
+            "n_steps": summary["n_steps"],
+            "per_step_mean": summary["per_step_mean"],
+            "range": summary["range"],
+            "overlap": summary["collective"],
+            "crosscheck": [f.as_dict() for f in checks],
+        }
+    except Exception as e:  # noqa: BLE001 — advisory metrics only
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 def _hlo_overlap_metrics() -> "dict | None":
@@ -415,6 +465,9 @@ def _hlo_overlap_metrics() -> "dict | None":
             from mpi4dl_tpu.analysis.metrics import publish_report
 
             publish_report(rep, _REGISTRY)
+        # The static report is the "should overlap" side the measured
+        # trace attribution cross-checks against (_trace_attribution).
+        _LAST_RUN["lint_report"] = rep
         return {
             "inventory": {k: v for k, v in rep.inventory.items() if v},
             "total_collective_bytes": rep.overlap["total_bytes"],
@@ -431,6 +484,49 @@ def _hlo_overlap_metrics() -> "dict | None":
         }
     except Exception as e:  # noqa: BLE001 — advisory metrics only
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
+def _trace_attribution() -> "dict | None":
+    """MEASURED device-time attribution of the headline train step: a
+    2-step XProf capture (Trainer.capture_trace_attribution), bucketed
+    compute/collective/transfer/host-gap + the measured-overlap verdict,
+    cross-checked against the static hlolint report when one landed.
+    BENCH_*.json thereby records the measured overlap trajectory next to
+    the static prediction. ``BENCH_ATTRIBUTION=0`` disables; failures
+    degrade to an error note."""
+    if (
+        os.environ.get("BENCH_ATTRIBUTION", "1") == "0"
+        or not _LAST_RUN
+    ):
+        return None
+    import shutil
+
+    logdir = tempfile.mkdtemp(prefix="mpi4dl-bench-train-trace-")
+    try:
+        tr = _LAST_RUN["trainer"]
+        state, summary = tr.capture_trace_attribution(
+            _LAST_RUN["state"], _LAST_RUN["xs"], _LAST_RUN["ys"],
+            steps=2, logdir=logdir, registry=_REGISTRY,
+            program="train_step",
+        )
+        _LAST_RUN["state"] = state
+        out = {
+            "n_steps": summary["n_steps"],
+            "per_step_mean": summary["per_step_mean"],
+            "overlap": summary["collective"],
+        }
+        lint_rep = _LAST_RUN.get("lint_report")
+        if lint_rep is not None:
+            from mpi4dl_tpu.analysis.trace import crosscheck_overlap
+
+            out["crosscheck"] = [
+                f.as_dict() for f in crosscheck_overlap(lint_rep, summary)
+            ]
+        return out
+    except Exception as e:  # noqa: BLE001 — advisory metrics only
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
 
 
 def main():
@@ -622,6 +718,10 @@ def main():
         hlo = _hlo_overlap_metrics()
         if hlo is not None:
             _RESULT["hlo"] = hlo
+            _emit()
+        attribution = _trace_attribution()
+        if attribution is not None:
+            _RESULT["attribution"] = attribution
             _emit()
     except Exception as e:  # noqa: BLE001 — extras may still succeed
         headline_error = f"{type(e).__name__}: {str(e)[:200]}"

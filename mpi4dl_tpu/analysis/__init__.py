@@ -16,7 +16,12 @@ artifacts into:
 - peak-memory extraction + committed-baseline regression checks
   (:mod:`mpi4dl_tpu.analysis.memory`),
 - a CLI (``python -m mpi4dl_tpu.analyze`` →
-  :mod:`mpi4dl_tpu.analysis.cli`).
+  :mod:`mpi4dl_tpu.analysis.cli`),
+- the runtime half (:mod:`mpi4dl_tpu.analysis.trace`): XProf Chrome-trace
+  parsing into per-step compute/collective/transfer/host-gap device-time
+  attribution plus a measured-overlap report that cross-checks the static
+  start→done rule against what the runtime actually did
+  (:func:`crosscheck_overlap`).
 
 Tier-1 tests lint the real compiled CPU-mesh programs with these rules, so
 a stray resharding ``all-to-all``, lost overlap, or a peak-HBM regression
@@ -50,4 +55,11 @@ from mpi4dl_tpu.analysis.rules import (  # noqa: F401
     LintContext,
     max_severity,
     run_rules,
+)
+from mpi4dl_tpu.analysis.trace import (  # noqa: F401
+    TraceError,
+    analyze_trace_dir,
+    crosscheck_overlap,
+    publish_attribution,
+    static_overlap_verdict,
 )

@@ -1,0 +1,533 @@
+"""Runtime device-time attribution from XProf Chrome traces.
+
+hlolint (:mod:`mpi4dl_tpu.analysis`) statically predicts communication
+structure and overlap from scheduled HLO; this module measures what
+actually happened at runtime. :func:`mpi4dl_tpu.profiling.capture` wraps
+``jax.profiler.trace`` around N annotated steps; the profiler emits a
+Chrome-trace JSON (``plugins/profile/<run>/*.trace.json.gz``) that this
+parser reads with stdlib ``gzip`` + ``json`` only — no TF/protobuf/xprof
+dependency — and turns into:
+
+- a typed event inventory (:class:`TraceEvent`) split into host and
+  device timelines by thread identity (CPU: the ``XLATfrtCpuClient``
+  executor threads carry per-HLO-op slices; TPU/GPU: ``/device:*``
+  process timelines, preferring the ``XLA Ops`` line to avoid counting
+  the module/step summary lines twice);
+- per-step attribution (:func:`attribute_steps`): device slices are
+  joined to the ``StepTraceAnnotation`` windows the train/serve dispatch
+  paths already emit (:func:`mpi4dl_tpu.profiling.annotate_step`, the
+  same host-side step ids the telemetry span log records), and each
+  step's wall time is bucketed into **compute / collective / transfer /
+  host_gap**. The buckets are exclusive by construction (priority
+  collective > transfer > compute on the merged interval union, host_gap
+  = wall − device-busy), so they sum exactly to the step wall time;
+- a **measured-overlap** report: for every collective slice, the
+  fraction of its duration during which compute was concurrently running
+  on another device timeline — the runtime counterpart of the static
+  start→done ``compute_between`` rule, per T3 (arXiv:2401.16677) / FLUX
+  (arXiv:2406.06858) the quantity that decides spatial-parallel
+  performance;
+- :func:`crosscheck_overlap`: static verdict vs measured verdict on the
+  same executable; disagreement ("schedule says the window is covered,
+  the trace shows exposed latency") is a new lint finding
+  (rule ``trace-overlap-crosscheck``).
+
+Degradation contract (tier-1 tested): a missing/empty trace directory
+raises :class:`TraceError` at the reader — never a KeyError three layers
+down — and a trace with no step annotations still yields a whole-range
+attribution (``n_steps == 0``) instead of failing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+
+from mpi4dl_tpu.analysis.rules import Finding
+
+#: Substrings (hyphenated HLO opcode stems) that mark a device slice as
+#: collective traffic. Fusion kernel names use underscores, so an
+#: ``all_reduce_fusion`` compute kernel does not false-positive here.
+COLLECTIVE_MARKERS = (
+    "collective-permute",
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "reduce-scatter",
+    "collective-broadcast",
+    "ragged-all-to-all",
+)
+
+#: Case-insensitive substrings marking host<->device / device<->device
+#: data movement (the "h2d" bucket; includes d2h and d2d).
+TRANSFER_MARKERS = (
+    "transfertodevice",
+    "transferfromdevice",
+    "transferraw",
+    "d2d dispatch",
+    "h2d",
+    "d2h",
+    "infeed",
+    "outfeed",
+    "copy-start",
+    "copy-done",
+    "bufferfromhost",
+    "buffertohost",
+)
+
+#: Thread-name substrings that mark a CPU-backend device timeline: the
+#: per-device TfrtCpuClient executor threads AND the shared XLAEigen
+#: intra-op pool — XLA's thunk executor schedules op thunks onto either,
+#: and which one a given op lands on varies run to run.
+_CPU_DEVICE_THREAD_MARKERS = (
+    "XLATfrtCpuClient",
+    "TfrtCpuDevice",
+    "XLAEigen",
+)
+
+#: Runtime bookkeeping that shows up on device executor threads but is
+#: not op execution (waits, region markers, executable wrappers). Counting
+#: the ``ExecuteHelper`` wrapper would double every op under it.
+_INFRA_PREFIXES = (
+    "ThreadpoolListener",
+    "ThunkExecutor",
+    "TfrtCpu",
+    "ParseArguments",
+    "PjitFunction",
+    "ExecuteThunks",
+    "$",  # python-source host slices
+)
+
+_TRAILING_ID = re.compile(r"\.\d+$")
+
+CATEGORIES = ("compute", "collective", "transfer", "host_gap")
+
+#: Measured overlap ratio at/above which a trace's collective time counts
+#: as "overlapped" (hidden behind compute) rather than "exposed".
+OVERLAPPED_MIN = 0.5
+
+
+class TraceError(RuntimeError):
+    """The trace directory is missing, empty, or unreadable."""
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    """One complete ("X") slice from the Chrome trace, times in seconds."""
+
+    name: str
+    pid: int
+    tid: int
+    start_s: float
+    end_s: float
+    category: str  # "compute" | "collective" | "transfer"
+
+    @property
+    def duration_s(self) -> float:
+        return self.end_s - self.start_s
+
+
+def categorize(name: str) -> "str | None":
+    """Device-slice category for an event name, or None for runtime
+    bookkeeping that must not count as device busy time."""
+    if any(m in name for m in COLLECTIVE_MARKERS):
+        return "collective"
+    low = name.lower()
+    if any(m in low for m in TRANSFER_MARKERS):
+        return "transfer"
+    if any(name.startswith(p) for p in _INFRA_PREFIXES):
+        return None
+    return "compute"
+
+
+def read_trace_events(trace_dir: str) -> "list[dict]":
+    """Raw ``traceEvents`` of the NEWEST profiler run under ``trace_dir``
+    (``plugins/profile/<run>/*.trace.json[.gz]``), all hosts merged.
+    Raises :class:`TraceError` when there is nothing to read."""
+    if not os.path.isdir(trace_dir):
+        raise TraceError(f"trace directory {trace_dir!r} does not exist")
+    runs = sorted(glob.glob(os.path.join(trace_dir, "plugins", "profile", "*")))
+    if not runs:
+        raise TraceError(
+            f"no profiler runs under {trace_dir!r} (expected "
+            "plugins/profile/<run>/ — did the capture actually trace?)"
+        )
+    run = runs[-1]
+    files = sorted(
+        glob.glob(os.path.join(run, "*.trace.json.gz"))
+        + glob.glob(os.path.join(run, "*.trace.json"))
+    )
+    if not files:
+        raise TraceError(f"profiler run {run!r} has no *.trace.json[.gz]")
+    events: list[dict] = []
+    for path in files:
+        opener = gzip.open if path.endswith(".gz") else open
+        try:
+            with opener(path, "rb") as f:
+                data = json.loads(f.read())
+        except (OSError, ValueError) as e:
+            raise TraceError(f"unreadable trace file {path!r}: {e}") from e
+        events.extend(data.get("traceEvents") or [])
+    return events
+
+
+def _name_tables(events) -> "tuple[dict, dict]":
+    """(process names by pid, thread names by (pid, tid)) from "M" events."""
+    procs: dict = {}
+    threads: dict = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", "")
+            )
+    return procs, threads
+
+
+def device_slices(events) -> "list[TraceEvent]":
+    """Device-timeline op slices, categorized; host threads and runtime
+    bookkeeping excluded.
+
+    CPU: XLA runs op thunks on the per-device ``XLATfrtCpuClient``
+    executor threads and the shared ``XLAEigen`` intra-op pool — both are
+    device timelines here. TPU/GPU: each device is a ``/device:*``
+    process whose ``XLA Ops`` thread carries the op timeline — when that
+    named line exists only it is used, since the ``XLA
+    Modules``/``Steps`` lines cover the same wall time again.
+    """
+    procs, threads = _name_tables(events)
+    dev_pids = {
+        pid for pid, name in procs.items()
+        if str(name).startswith("/device:")
+    }
+    # Per accelerator pid: restrict to the "XLA Ops" line when present.
+    ops_threads: dict = {}
+    for (pid, tid), tname in threads.items():
+        if pid in dev_pids and "XLA Ops" in str(tname):
+            ops_threads.setdefault(pid, set()).add(tid)
+
+    out: list[TraceEvent] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid, tid = e.get("pid"), e.get("tid")
+        tname = str(threads.get((pid, tid), ""))
+        if pid in dev_pids:
+            allowed = ops_threads.get(pid)
+            if allowed is not None and tid not in allowed:
+                continue
+            if any(k in tname for k in ("Steps", "Modules", "Framework",
+                                        "Scope", "Source")):
+                continue
+        elif not any(m in tname for m in _CPU_DEVICE_THREAD_MARKERS):
+            continue  # host thread
+        cat = categorize(str(e.get("name", "")))
+        if cat is None:
+            continue
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        out.append(TraceEvent(
+            name=str(e.get("name")), pid=pid, tid=tid,
+            start_s=ts / 1e6, end_s=(ts + dur) / 1e6, category=cat,
+        ))
+    out.sort(key=lambda ev: ev.start_s)
+    return out
+
+
+def step_windows(events, step_name: str) -> "list[tuple[float, float, str]]":
+    """``(start_s, end_s, step_num)`` for every X event named exactly
+    ``step_name`` — the ``StepTraceAnnotation`` windows."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != step_name:
+            continue
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        num = str((e.get("args") or {}).get("step_num", len(out)))
+        out.append((ts / 1e6, (ts + dur) / 1e6, num))
+    out.sort()
+    return out
+
+
+# -- interval algebra (merged, half-open [s, e) second intervals) -------------
+
+
+def _merged(intervals) -> "list[tuple[float, float]]":
+    out: list[list[float]] = []
+    for s, e in sorted(intervals):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _total(merged) -> float:
+    return sum(e - s for s, e in merged)
+
+
+def _clip(intervals, lo: float, hi: float):
+    return [
+        (max(s, lo), min(e, hi))
+        for s, e in intervals
+        if min(e, hi) > max(s, lo)
+    ]
+
+
+def _intersect(a_merged, b_merged) -> "list[tuple[float, float]]":
+    out, i, j = [], 0, 0
+    while i < len(a_merged) and j < len(b_merged):
+        s = max(a_merged[i][0], b_merged[j][0])
+        e = min(a_merged[i][1], b_merged[j][1])
+        if e > s:
+            out.append((s, e))
+        if a_merged[i][1] <= b_merged[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _subtract(a_merged, b_merged) -> "list[tuple[float, float]]":
+    out = []
+    j = 0
+    for s, e in a_merged:
+        cur = s
+        while j < len(b_merged) and b_merged[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b_merged) and b_merged[k][0] < e:
+            bs, be = b_merged[k]
+            if bs > cur:
+                out.append((cur, bs))
+            cur = max(cur, be)
+            if cur >= e:
+                break
+            k += 1
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+# -- attribution --------------------------------------------------------------
+
+
+def _bucket(slices, lo: float, hi: float) -> dict:
+    """Exclusive category times over [lo, hi): collective > transfer >
+    compute on the merged union, host_gap = wall − device-busy. The four
+    buckets sum to ``hi - lo`` exactly."""
+    by_cat = {c: [] for c in ("collective", "transfer", "compute")}
+    for ev in slices:
+        by_cat[ev.category].append((ev.start_s, ev.end_s))
+    coll = _merged(_clip(by_cat["collective"], lo, hi))
+    tran = _merged(_clip(by_cat["transfer"], lo, hi))
+    comp = _merged(_clip(by_cat["compute"], lo, hi))
+    collective_s = _total(coll)
+    transfer_s = _total(_subtract(tran, coll))
+    comm = _merged(list(coll) + list(tran))
+    compute_s = _total(_subtract(comp, comm))
+    busy_s = collective_s + transfer_s + compute_s
+    wall_s = hi - lo
+    return {
+        "wall_s": wall_s,
+        "compute_s": compute_s,
+        "collective_s": collective_s,
+        "transfer_s": transfer_s,
+        "host_gap_s": max(0.0, wall_s - busy_s),
+        "device_busy_s": busy_s,
+    }
+
+
+def attribute_steps(slices, windows) -> "list[dict]":
+    """Per-step attribution: device slices joined (clipped) to each
+    annotation window."""
+    steps = []
+    for lo, hi, num in windows:
+        rec = {"step": num, "start_s": lo, "end_s": hi}
+        rec.update(_bucket(slices, lo, hi))
+        steps.append(rec)
+    return steps
+
+
+def measured_overlap(slices) -> dict:
+    """Per-collective-slice overlap with concurrent compute on OTHER
+    device timelines: the runtime analogue of the static
+    ``compute_between`` count. Returns totals, the overall ratio, a
+    per-op-stem breakdown, and a verdict ("no-collectives" /
+    "overlapped" / "exposed", threshold 0.5)."""
+    comp_by_thread: dict = {}
+    for ev in slices:
+        if ev.category == "compute":
+            comp_by_thread.setdefault((ev.pid, ev.tid), []).append(
+                (ev.start_s, ev.end_s)
+            )
+    comp_by_thread = {k: _merged(v) for k, v in comp_by_thread.items()}
+    total = overlapped = 0.0
+    by_op: dict = {}
+    for ev in slices:
+        if ev.category != "collective":
+            continue
+        other = _merged([
+            iv
+            for key, merged in comp_by_thread.items()
+            if key != (ev.pid, ev.tid)
+            for iv in merged
+        ])
+        got = _total(_intersect([(ev.start_s, ev.end_s)], other))
+        total += ev.duration_s
+        overlapped += got
+        stem = _TRAILING_ID.sub("", ev.name)
+        rec = by_op.setdefault(stem, {"n": 0, "total_s": 0.0,
+                                      "overlapped_s": 0.0})
+        rec["n"] += 1
+        rec["total_s"] += ev.duration_s
+        rec["overlapped_s"] += got
+    ratio = overlapped / total if total > 0 else None
+    if total == 0:
+        verdict = "no-collectives"
+    else:
+        # Epsilon absorbs the us->s float conversion so an exactly-half
+        # overlapped trace doesn't flap between verdicts.
+        verdict = (
+            "overlapped" if ratio >= OVERLAPPED_MIN - 1e-9 else "exposed"
+        )
+    return {
+        "total_s": total,
+        "overlapped_s": overlapped,
+        "overlap_ratio": ratio,
+        "by_op": by_op,
+        "verdict": verdict,
+    }
+
+
+def analyze_events(events, step_name: str) -> dict:
+    """Full attribution summary over raw ``traceEvents``. Works with zero
+    step annotations (``n_steps == 0``; the whole-range bucket still
+    answers "where did device time go")."""
+    slices = device_slices(events)
+    windows = step_windows(events, step_name)
+    steps = attribute_steps(slices, windows)
+    keys = ("wall_s", "compute_s", "collective_s", "transfer_s",
+            "host_gap_s", "device_busy_s")
+    totals = {k: sum(s[k] for s in steps) for k in keys}
+    mean = (
+        {k: totals[k] / len(steps) for k in keys} if steps else None
+    )
+    if slices:
+        lo = min(ev.start_s for ev in slices)
+        hi = max(ev.end_s for ev in slices)
+        rng = _bucket(slices, lo, hi)
+        rng["span_s"] = rng.pop("wall_s")
+    else:
+        rng = {"span_s": 0.0, "compute_s": 0.0, "collective_s": 0.0,
+               "transfer_s": 0.0, "host_gap_s": 0.0, "device_busy_s": 0.0}
+    return {
+        "step_name": step_name,
+        "n_steps": len(steps),
+        "n_device_slices": len(slices),
+        "steps": steps,
+        "totals": totals,
+        "per_step_mean": mean,
+        "range": rng,
+        "collective": measured_overlap(slices),
+    }
+
+
+def analyze_trace_dir(trace_dir: str, step_name: str = "mpi4dl_capture") -> dict:
+    """Read + attribute one capture directory. The default ``step_name``
+    matches :func:`mpi4dl_tpu.profiling.capture`; pass
+    ``"mpi4dl_train_step"`` / ``"mpi4dl_serve_batch"`` to attribute the
+    annotations the train/serve dispatch paths emit on their own."""
+    summary = analyze_events(read_trace_events(trace_dir), step_name)
+    summary["trace_dir"] = trace_dir
+    return summary
+
+
+# -- telemetry + static cross-check -------------------------------------------
+
+
+def publish_attribution(summary: dict, registry, program: str = "capture"):
+    """Publish one attribution summary under the cataloged ``trace_*``
+    gauges (docs/OBSERVABILITY.md), labeled by ``program`` so train and
+    serve captures coexist in one registry. Per-step means when the
+    capture had annotated steps, whole-range totals otherwise."""
+    from mpi4dl_tpu import telemetry
+
+    src = summary["per_step_mean"] or summary["range"]
+    attr = telemetry.declare(registry, "trace_attribution_seconds")
+    for cat in CATEGORIES:
+        attr.set(src.get(f"{cat}_s", 0.0), program=program, category=cat)
+    if summary["per_step_mean"] is not None:
+        telemetry.declare(registry, "trace_step_wall_seconds").set(
+            summary["per_step_mean"]["wall_s"], program=program
+        )
+    ratio = summary["collective"]["overlap_ratio"]
+    if ratio is not None:
+        telemetry.declare(registry, "trace_overlap_ratio").set(
+            ratio, program=program
+        )
+    return registry
+
+
+def static_overlap_verdict(overlap: dict) -> str:
+    """Collapse a static ``Report.overlap`` summary into one verdict:
+    "no-collectives", "sync" (collectives but no async start/done pairs —
+    the schedule makes no overlap claim), "exposed" (async pairs with
+    zero compute between), or "overlapped"."""
+    if overlap.get("n_collectives", 0) == 0:
+        return "no-collectives"
+    if overlap.get("async_pairs", 0) == 0:
+        return "sync"
+    return "exposed" if overlap.get("zero_overlap") else "overlapped"
+
+
+def crosscheck_overlap(report, summary: dict) -> "list[Finding]":
+    """Static says "should overlap"; the trace says "did". Disagreement
+    between the two verdicts on the same executable is a lint finding
+    (rule ``trace-overlap-crosscheck``) — the closed loop between
+    hlolint's schedule prediction and runtime reality. ``report`` is a
+    :class:`mpi4dl_tpu.analysis.report.Report` or any dict carrying its
+    ``overlap`` summary."""
+    overlap = report["overlap"] if isinstance(report, dict) else report.overlap
+    static = static_overlap_verdict(overlap)
+    meas = summary["collective"]
+    measured = meas["verdict"]
+    rule = "trace-overlap-crosscheck"
+    if static == "no-collectives" and measured != "no-collectives":
+        return [Finding(rule, "warn",
+                        f"static analysis saw zero collectives but the trace "
+                        f"recorded {meas['total_s'] * 1e3:.3f} ms of "
+                        "collective slices: the captured program is not the "
+                        "analyzed one, or communication crept in at runtime.")]
+    if static != "no-collectives" and measured == "no-collectives":
+        return [Finding(rule, "warn",
+                        f"static analysis counts "
+                        f"{overlap.get('n_collectives')} collectives but the "
+                        "trace recorded none: capture too short, wrong "
+                        "program, or the runtime elided them.")]
+    if static == "overlapped" and measured == "exposed":
+        return [Finding(rule, "warn",
+                        "static schedule places compute inside every "
+                        "collective start->done window, but the measured "
+                        f"overlap ratio is {meas['overlap_ratio']:.2f}: the "
+                        "communication window is exposed latency at runtime "
+                        "(T3/FLUX lost-overlap, invisible to the static "
+                        "rule).")]
+    if static == "exposed" and measured == "overlapped":
+        return [Finding(rule, "info",
+                        "static analysis flags zero-overlap collectives but "
+                        "the runtime overlapped "
+                        f"{meas['overlap_ratio']:.0%} of collective time "
+                        "anyway (asynchronous progress outside the schedule).")]
+    return []

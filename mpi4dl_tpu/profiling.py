@@ -18,7 +18,13 @@ equivalents:
 - :func:`annotate_step` — ``jax.profiler.StepTraceAnnotation`` wrapper the
   train/serve dispatch paths use, so XProf step boundaries carry the same
   step/batch ids as the telemetry span log
-  (:mod:`mpi4dl_tpu.telemetry.spans`) and the two can be joined.
+  (:mod:`mpi4dl_tpu.telemetry.spans`) and the two can be joined;
+- :func:`capture` — programmatic trace capture: wraps :func:`trace` around
+  N annotated, fully-blocked invocations of a step function and returns a
+  :class:`Capture` whose :meth:`Capture.attribution` parses the emitted
+  Chrome trace into a compute/collective/transfer/host-gap device-time
+  report (:mod:`mpi4dl_tpu.analysis.trace`) — the runtime counterpart of
+  hlolint's static overlap rule.
 
 :class:`StepTimer` optionally publishes into a telemetry registry
 (:mod:`mpi4dl_tpu.telemetry`): per-step ``train_step_seconds`` histogram
@@ -30,8 +36,10 @@ catalog (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 import statistics
+import tempfile
 import time
 from typing import Any
 
@@ -68,14 +76,22 @@ class StepTimer:
     ``registry``: an optional :class:`mpi4dl_tpu.telemetry.MetricsRegistry`;
     each post-warmup step then also lands in the cataloged ``train_*``
     metrics (histogram + counter + throughput gauge).
+
+    ``watchdog``: an optional :class:`mpi4dl_tpu.telemetry.Watchdog`; the
+    timer then reports step begin/completion to it, so a hung step (no
+    completion within K× the rolling p99) trips the same liveness
+    machinery the serving engine uses.
     """
 
-    def __init__(self, batch_size: int, warmup: int = 1, registry=None):
+    def __init__(
+        self, batch_size: int, warmup: int = 1, registry=None, watchdog=None
+    ):
         self.batch_size = batch_size
         self.warmup = warmup
         self.times: list[float] = []
         self._seen = 0
         self._metrics = None
+        self._watchdog = watchdog
         if registry is not None:
             from mpi4dl_tpu import telemetry
 
@@ -90,11 +106,18 @@ class StepTimer:
         import jax
 
         out: list[Any] = []
-        t0 = time.perf_counter()
-        yield out.append
-        if out:
-            jax.block_until_ready(out[-1])
-        dt = time.perf_counter() - t0
+        if self._watchdog is not None:
+            self._watchdog.begin()
+        dt = None
+        try:
+            t0 = time.perf_counter()
+            yield out.append
+            if out:
+                jax.block_until_ready(out[-1])
+            dt = time.perf_counter() - t0
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.done(dt)
         self._seen += 1
         if self._seen > self.warmup:
             self.times.append(dt)
@@ -106,7 +129,10 @@ class StepTimer:
 
     @property
     def images_per_sec(self) -> list[float]:
-        return [self.batch_size / t for t in self.times]
+        # dt == 0 (a clock too coarse for a trivial step) reports 0.0
+        # throughput — same convention as the telemetry gauge above —
+        # instead of raising ZeroDivisionError in summary().
+        return [self.batch_size / t if t > 0 else 0.0 for t in self.times]
 
     def summary(self) -> dict:
         if not self.times:
@@ -158,3 +184,71 @@ def annotate_step(name: str, step: "int | None" = None):
         return
     with ann:
         yield
+
+
+#: Annotation name :func:`capture` wraps around each step. Distinct from
+#: the dispatch-path names ("mpi4dl_train_step"/"mpi4dl_serve_batch") so
+#: a capture window strictly CONTAINS each step's device work (the block
+#: happens inside the annotation), even when the step function annotates
+#: its own async dispatch internally.
+CAPTURE_STEP_NAME = "mpi4dl_capture"
+
+
+@dataclasses.dataclass
+class Capture:
+    """One finished :func:`capture`: where the trace landed, plus the
+    host-measured wall time of each annotated step (the independent
+    ground truth the attribution's per-step sums are checked against)."""
+
+    trace_dir: str
+    step_name: str
+    n_steps: int
+    step_times_s: list
+
+    def attribution(self, registry=None, program: str = "capture") -> dict:
+        """Parse the emitted Chrome trace into the per-step
+        compute/collective/transfer/host-gap report
+        (:func:`mpi4dl_tpu.analysis.trace.analyze_trace_dir`); with a
+        ``registry``, also publish the cataloged ``trace_*`` gauges
+        under ``program``."""
+        from mpi4dl_tpu.analysis.trace import (
+            analyze_trace_dir,
+            publish_attribution,
+        )
+
+        summary = analyze_trace_dir(self.trace_dir, step_name=self.step_name)
+        summary["host_step_times_s"] = list(self.step_times_s)
+        if registry is not None:
+            publish_attribution(summary, registry, program=program)
+        return summary
+
+
+def capture(
+    step_fn,
+    steps: int = 3,
+    logdir: "str | None" = None,
+    name: str = CAPTURE_STEP_NAME,
+) -> Capture:
+    """Trace ``steps`` invocations of ``step_fn(i)`` under
+    ``jax.profiler.trace``, each wrapped in a step annotation with the
+    result blocked to completion INSIDE the annotation — so every step's
+    device work falls within its window and the attribution buckets sum
+    to the step wall time. ``logdir=None`` captures into a fresh temp
+    directory (reported on :attr:`Capture.trace_dir`)."""
+    import jax
+
+    if logdir is None:
+        logdir = tempfile.mkdtemp(prefix="mpi4dl-capture-")
+    times: list[float] = []
+    with trace(logdir):
+        for i in range(int(steps)):
+            t0 = time.perf_counter()
+            with annotate_step(name, i):
+                out = step_fn(i)
+                if out is not None:
+                    jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+    return Capture(
+        trace_dir=logdir, step_name=name, n_steps=int(steps),
+        step_times_s=times,
+    )

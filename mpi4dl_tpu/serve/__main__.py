@@ -65,6 +65,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry-dir", default=None,
                    help="write JSONL span/metrics events here "
                         "(default: $MPI4DL_TPU_TELEMETRY_DIR, unset = off)")
+    p.add_argument("--watchdog-factor", type=float, default=20.0,
+                   help="trip the stalled-loop watchdog at this multiple "
+                        "of the rolling p99 request latency (0 disables)")
+    p.add_argument("--watchdog-min-timeout", type=float, default=2.0,
+                   help="floor of the watchdog timeout, seconds")
+    p.add_argument("--flight-capacity", type=int, default=512,
+                   help="flight-recorder ring size in events (0 disables)")
+    p.add_argument("--flight-dir", default=None,
+                   help="where watchdog/crash/SIGTERM flight dumps land "
+                        "(default: the telemetry dir, then the temp dir)")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture an XProf trace of the load run here and "
+                        "attribute device time per serve batch "
+                        "(report key 'attribution', /debugz, trace_* "
+                        "gauges)")
     p.add_argument("--json", dest="json_out", default=None,
                    help="also write the report JSON here")
     return p
@@ -98,7 +113,17 @@ def _synthetic_engine(args):
         max_queue=args.max_queue,
         default_deadline_s=args.deadline_ms / 1e3,
         metrics_port=args.metrics_port, telemetry_dir=args.telemetry_dir,
+        **_liveness_kw(args),
     )
+
+
+def _liveness_kw(args) -> dict:
+    return {
+        "watchdog_factor": args.watchdog_factor or None,
+        "watchdog_min_timeout_s": args.watchdog_min_timeout,
+        "flight_capacity": args.flight_capacity,
+        "flight_dir": args.flight_dir,
+    }
 
 
 def main(argv=None) -> int:
@@ -121,9 +146,14 @@ def main(argv=None) -> int:
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,
             default_deadline_s=args.deadline_ms / 1e3,
             metrics_port=args.metrics_port, telemetry_dir=args.telemetry_dir,
+            **_liveness_kw(args),
         )
     else:
         engine = _synthetic_engine(args)
+
+    # Postmortem on SIGTERM: dump the flight ring before the default
+    # disposition terminates the process.
+    engine.flight.install_signal_handlers()
 
     report = {
         "model": "checkpoint:" + args.ckpt if args.ckpt else
@@ -135,26 +165,57 @@ def main(argv=None) -> int:
         # stderr, not stdout: the stdout protocol is "keep the last JSON
         # line", and the scrape URL must be visible while the run is live.
         print(
-            f"# metrics: http://127.0.0.1:{engine.metrics_port}/metrics",
+            f"# metrics: http://127.0.0.1:{engine.metrics_port}/metrics "
+            f"(also /healthz, /debugz)",
             file=sys.stderr, flush=True,
         )
     if args.serial:
         report["serial"] = serial_throughput(engine, args.serial)
 
+    from contextlib import nullcontext
+
+    from mpi4dl_tpu.profiling import trace as profiler_trace
+
     engine.start()
     try:
-        if args.mode == "closed":
-            report["loadgen"] = run_closed_loop(
-                engine, args.requests, concurrency=args.concurrency,
-                deadline_s=args.deadline_ms / 1e3,
-            )
-        else:
-            report["loadgen"] = run_open_loop(
-                engine, rate_rps=args.rate, duration_s=args.duration,
-                deadline_s=args.deadline_ms / 1e3,
-            )
+        with profiler_trace(args.trace_dir) if args.trace_dir \
+                else nullcontext():
+            if args.mode == "closed":
+                report["loadgen"] = run_closed_loop(
+                    engine, args.requests, concurrency=args.concurrency,
+                    deadline_s=args.deadline_ms / 1e3,
+                )
+            else:
+                report["loadgen"] = run_open_loop(
+                    engine, rate_rps=args.rate, duration_s=args.duration,
+                    deadline_s=args.deadline_ms / 1e3,
+                )
     finally:
         engine.stop()
+
+    if args.trace_dir:
+        try:
+            from mpi4dl_tpu.analysis.trace import (
+                analyze_trace_dir,
+                publish_attribution,
+            )
+
+            summary = analyze_trace_dir(
+                args.trace_dir, step_name="mpi4dl_serve_batch"
+            )
+            publish_attribution(
+                summary, engine.registry, program="serve_batch"
+            )
+            engine.set_attribution(summary)
+            report["attribution"] = {
+                k: summary[k]
+                for k in ("n_steps", "per_step_mean", "range", "collective")
+            }
+        except Exception as e:  # noqa: BLE001 — attribution is advisory;
+            # the load report must survive a broken trace
+            report["attribution"] = {
+                "error": f"{type(e).__name__}: {str(e)[:160]}"
+            }
 
     if args.serial and report["serial"]["throughput_rps"] > 0:
         report["speedup_vs_serial"] = (

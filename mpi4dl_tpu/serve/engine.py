@@ -40,6 +40,17 @@ span events to a JSONL log. Both are opt-in; the registry itself is
 always on (a few lock-guarded float adds per request — batched throughput
 measured flat within ±1.5% noise across all telemetry arms; the overhead
 table in docs/OBSERVABILITY.md).
+
+Liveness + postmortem (docs/OBSERVABILITY.md "Flight recorder" / "Health
+endpoints"): a :class:`telemetry.Watchdog` trips when requests are
+outstanding but none completes within ``watchdog_factor`` × the rolling
+p99 e2e latency (seeded with the AOT warm latency), flipping
+:attr:`health` — served as ``/healthz`` 200→503 on the metrics port —
+and dumping the always-on :class:`telemetry.FlightRecorder` ring (recent
+span events + rate-limited metric snapshots) as schema-valid JSONL; the
+batcher crashing or a SIGTERM (``serve.__main__``) dumps it too.
+``/debugz`` serves the flight-recorder tail, watchdog state, and the
+latest trace-attribution summary live.
 """
 
 from __future__ import annotations
@@ -96,10 +107,20 @@ class ServingEngine:
     registry: a shared :class:`telemetry.MetricsRegistry`; None creates a
         private one (exposed as :attr:`registry`).
     metrics_port: serve the registry as a Prometheus ``/metrics`` endpoint
-        on this port (0 = ephemeral; bound port on :attr:`metrics_port`).
+        on this port (0 = ephemeral; bound port on :attr:`metrics_port`),
+        plus ``/healthz`` (200/503 from :attr:`health`) and ``/debugz``
+        (flight tail + watchdog state + latest attribution).
         None (default) starts no server.
     telemetry_dir: JSONL span-event log directory; None falls back to
         ``MPI4DL_TPU_TELEMETRY_DIR``, unset disables.
+    watchdog_factor: trip the stalled-loop watchdog when no request
+        completes within ``factor`` × rolling p99 e2e latency (floored at
+        ``watchdog_min_timeout_s``) while work is outstanding; None or 0
+        disables the watchdog.
+    flight_capacity: flight-recorder ring size in events (0 disables).
+    flight_dir: where watchdog/crash dumps land; defaults to the
+        telemetry dir, then ``MPI4DL_TPU_TELEMETRY_DIR``, then the
+        system temp dir.
     """
 
     def __init__(
@@ -117,6 +138,10 @@ class ServingEngine:
         registry=None,
         metrics_port: "int | None" = None,
         telemetry_dir: "str | None" = None,
+        watchdog_factor: "float | None" = 20.0,
+        watchdog_min_timeout_s: float = 2.0,
+        flight_capacity: int = 512,
+        flight_dir: "str | None" = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -192,8 +217,33 @@ class ServingEngine:
         warm = decl("serve_warm_latency_seconds")
         for b, t in self.warm_latency_s.items():
             warm.set(t, bucket=b)
+
+        # -- liveness + postmortem ------------------------------------------
+        self.health = telemetry.HealthState(registry=self.registry)
+        self.flight = telemetry.FlightRecorder(
+            capacity=flight_capacity,
+            registry=self.registry,
+            directory=flight_dir or telemetry_dir,
+        )
+        self.last_attribution: "dict | None" = None
+        self.watchdog: "telemetry.Watchdog | None" = None
+        if watchdog_factor:
+            self.watchdog = telemetry.Watchdog(
+                factor=watchdog_factor,
+                min_timeout_s=watchdog_min_timeout_s,
+                registry=self.registry,
+                health=self.health,
+                on_trip=(self._on_watchdog_trip,),
+            )
+            # Prime the rolling-p99 history so the adaptive timeout is
+            # meaningful before the first served request.
+            self.watchdog.seed(max(self.warm_latency_s.values()))
+
         self._server = (
-            telemetry.MetricsServer(self.registry, port=metrics_port)
+            telemetry.MetricsServer(
+                self.registry, port=metrics_port,
+                health=self.health.snapshot, debug=self._debugz,
+            )
             if metrics_port is not None
             else None
         )
@@ -244,6 +294,7 @@ class ServingEngine:
         if self._thread is not None and self._thread.is_alive():
             return
         self._stop_evt.clear()
+        self._record_marker("serve.start")
         self._thread = threading.Thread(
             target=self._loop, name="mpi4dl-serve-batcher", daemon=True
         )
@@ -259,8 +310,12 @@ class ServingEngine:
             self._thread.join()
             self._thread = None
         self._flush_queue("engine stopped before this request was served")
+        self._record_marker("serve.stop")
         # The exporters die with the engine; the registry itself stays
-        # readable (stats(), snapshots) after stop.
+        # readable (stats(), snapshots) after stop, and the flight ring
+        # stays dumpable.
+        if self.watchdog is not None:
+            self.watchdog.close()
         if self._server is not None:
             self._server.close()
             self._server = None
@@ -289,9 +344,18 @@ class ServingEngine:
         with self._lock:
             self._counts["submitted"] += 1
         self._m_submitted.inc()
+        # Arm the watchdog BEFORE the enqueue: if the loop has already
+        # stalled, the very request that exposes it must be counted as
+        # outstanding. A queue-full reject cancels (not "done" — an
+        # admission bounce is not loop progress and must not reset the
+        # stall clock).
+        if self.watchdog is not None:
+            self.watchdog.begin()
         try:
             self._q.put_nowait(req)
         except queue.Full:
+            if self.watchdog is not None:
+                self.watchdog.cancel()
             with self._lock:
                 self._counts["rejected_queue_full"] += 1
             self._m_requests.inc(outcome="rejected_queue_full")
@@ -328,7 +392,41 @@ class ServingEngine:
         out["pad_waste_ratio"] = padded / total if total else 0.0
         out["buckets"] = list(self._buckets)
         out["warm_latency_s"] = dict(self.warm_latency_s)
+        out["healthy"] = self.health.healthy
         return out
+
+    # -- liveness + postmortem -----------------------------------------------
+
+    def _record_marker(self, name: str, **attrs) -> None:
+        if self.flight.enabled:
+            self.flight.record({
+                "ts": time.time(), "kind": "event", "name": name,
+                "attrs": attrs,
+            })
+
+    def _on_watchdog_trip(self, reason: str) -> None:
+        """Watchdog callback: mark + dump the flight ring. The health
+        flip and trip counter already happened inside the watchdog."""
+        self._record_marker("serve.watchdog_trip", reason=reason)
+        self.flight.dump(reason="watchdog")
+
+    def set_attribution(self, summary: dict) -> None:
+        """Attach the latest trace-attribution summary
+        (:mod:`mpi4dl_tpu.analysis.trace`) so ``/debugz`` serves it."""
+        self.last_attribution = summary
+
+    def _debugz(self) -> dict:
+        return {
+            "stats": self.stats(),
+            "health": self.health.snapshot(),
+            "watchdog": self.watchdog.state() if self.watchdog else None,
+            "flight_tail": self.flight.tail(50),
+            "attribution": self.last_attribution,
+        }
+
+    def dump_flight(self, path: "str | None" = None, reason: str = "manual"):
+        """Dump the flight-recorder ring now; returns the JSONL path."""
+        return self.flight.dump(path=path, reason=reason)
 
     def lint_report(self, bucket: int | None = None):
         """hlolint gate over a serving executable's HLO: the single-chip
@@ -353,6 +451,21 @@ class ServingEngine:
     # -- batcher loop --------------------------------------------------------
 
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — the batcher dying is
+            # the flight recorder's reason to exist: dump the last N
+            # requests, flip health, fail what's queued, then surface.
+            self.health.set_unhealthy(f"batcher crashed: {e!r}")
+            self._record_marker("serve.crash", error=repr(e))
+            try:
+                self.flight.dump(reason="crash")
+            except Exception:  # noqa: BLE001 — postmortem best-effort
+                pass
+            self._flush_queue(f"batcher crashed: {e!r}")
+            raise
+
+    def _loop_inner(self) -> None:
         inflight = None
         while True:
             reqs = self._form_batch()
@@ -363,8 +476,13 @@ class ServingEngine:
                 except Exception as e:  # noqa: BLE001 — a bad batch must
                     # fail its own requests, not kill the batcher thread
                     # (hanging every future ever submitted after it).
+                    self._record_marker(
+                        "serve.batch_error", error=repr(e), batch=len(reqs)
+                    )
                     for r in reqs:
                         r.future.set_exception(e)
+                        if self.watchdog is not None:
+                            self.watchdog.done()
             if inflight is not None:
                 self._complete(*inflight)
             inflight = staged
@@ -442,6 +560,8 @@ class ServingEngine:
             self._counts["batches"] += 1
             self._counts["batched_examples"] += len(reqs)
         for i, r in enumerate(reqs):
+            if self.watchdog is not None:
+                self.watchdog.done(now - r.submit_t)
             if now > r.deadline:
                 with self._lock:
                     self._counts["served_late"] += 1
@@ -477,26 +597,36 @@ class ServingEngine:
             ("device_compute", end_t),
         ])
         telemetry.record_spans(self._m_spans, spans)
-        if self._events.enabled:
-            self._events.write(telemetry.span_event(
+        if self.flight.enabled or self._events.enabled:
+            ev = telemetry.span_event(
                 "serve.request", r.trace_id, spans,
                 attrs={"outcome": outcome, "bucket": bucket,
                        "batch_size": batch_size,
                        "e2e_latency_s": end_t - r.submit_t},
-            ))
+            )
+            self.flight.record(ev)
+            if self._events.enabled:
+                self._events.write(ev)
 
     def _reject_deadline(self, req: _Request) -> None:
         with self._lock:
             self._counts["rejected_deadline"] += 1
         self._m_requests.inc(outcome="rejected_deadline")
-        if self._events.enabled:
+        if self.watchdog is not None:
+            # A formation-time rejection is loop progress: the batcher is
+            # alive and draining.
+            self.watchdog.done()
+        if self.flight.enabled or self._events.enabled:
             spans = telemetry.spans_from_marks([
                 ("submit", req.submit_t), ("queue_wait", req.form_t),
             ])
-            self._events.write(telemetry.span_event(
+            ev = telemetry.span_event(
                 "serve.request", req.trace_id, spans,
                 attrs={"outcome": "rejected_deadline"},
-            ))
+            )
+            self.flight.record(ev)
+            if self._events.enabled:
+                self._events.write(ev)
         req.future.set_exception(DeadlineExceededError(
             "deadline expired while the request waited for batch formation"
         ))
@@ -507,4 +637,6 @@ class ServingEngine:
                 req = self._q.get_nowait()
             except queue.Empty:
                 return
+            if self.watchdog is not None:
+                self.watchdog.cancel()
             req.future.set_exception(RuntimeError(msg))

@@ -18,7 +18,13 @@ signals from. Four pieces:
 - :mod:`.catalog` — the single source of truth for metric names/types/
   labels; publishers go through :func:`declare`, and tier-1 tests pin the
   catalog against both ``docs/OBSERVABILITY.md`` and what a full-stack
-  run actually exposes.
+  run actually exposes;
+- :mod:`.flight` — always-on bounded ring of recent spans + metric
+  snapshots, dumped as schema-valid JSONL on watchdog trip, crash, or
+  SIGTERM (the postmortem story);
+- :mod:`.health` — :class:`HealthState` behind the ``/healthz`` endpoint
+  and the :class:`Watchdog` that flips it on hung-step / stalled-loop
+  detection.
 
 Who publishes what: ``serve.ServingEngine`` (request outcomes, queue
 depth, bucket occupancy, pad waste, latency + lifecycle spans),
@@ -39,6 +45,11 @@ from mpi4dl_tpu.telemetry.catalog import (  # noqa: F401
 from mpi4dl_tpu.telemetry.export import (  # noqa: F401
     MetricsServer,
     render_prometheus,
+)
+from mpi4dl_tpu.telemetry.flight import FlightRecorder  # noqa: F401
+from mpi4dl_tpu.telemetry.health import (  # noqa: F401
+    HealthState,
+    Watchdog,
 )
 from mpi4dl_tpu.telemetry.jsonl import (  # noqa: F401
     ENV_DIR,

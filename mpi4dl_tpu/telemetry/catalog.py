@@ -80,6 +80,40 @@ CATALOG: "dict[str, MetricSpec]" = {
         "First post-compile execution latency per bucket, measured at "
         "AOT warm-up.",
     ),
+    "serve_healthy": MetricSpec(
+        "gauge", (),
+        "1 while the engine's health state is OK, 0 after a watchdog "
+        "trip or batcher crash — the scrapeable twin of /healthz.",
+    ),
+    # -- liveness + postmortem (mpi4dl_tpu/telemetry/health.py, flight.py) ---
+    "watchdog_trips_total": MetricSpec(
+        "counter", (),
+        "Watchdog trips: work was outstanding but nothing completed "
+        "within max(min timeout, K x rolling p99 completion time).",
+    ),
+    "flight_recorder_dumps_total": MetricSpec(
+        "counter", ("reason",),
+        "Flight-recorder postmortem dumps, by trigger: watchdog, crash, "
+        "sigterm, manual.",
+    ),
+    # -- trace attribution (mpi4dl_tpu/analysis/trace.py) --------------------
+    "trace_attribution_seconds": MetricSpec(
+        "gauge", ("program", "category"),
+        "Per-step mean device-time attribution from the latest XProf "
+        "capture: compute, collective, transfer, host_gap (whole-range "
+        "totals when the capture had no step annotations).",
+    ),
+    "trace_step_wall_seconds": MetricSpec(
+        "gauge", ("program",),
+        "Mean annotated-step wall time in the latest capture — the "
+        "denominator the attribution categories sum to.",
+    ),
+    "trace_overlap_ratio": MetricSpec(
+        "gauge", ("program",),
+        "Measured fraction of collective time overlapped by concurrent "
+        "compute in the latest capture (1.0 = fully hidden; absent when "
+        "the capture saw no collectives).",
+    ),
     # -- load generator (mpi4dl_tpu/serve/loadgen.py) ------------------------
     "loadgen_requests_total": MetricSpec(
         "counter", ("outcome",),

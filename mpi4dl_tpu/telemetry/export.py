@@ -8,10 +8,19 @@ daemon ``http.server`` thread — stdlib only (the container must not need
 ``prometheus_client``), opt-in via ``ServingEngine(metrics_port=...)`` or
 ``python -m mpi4dl_tpu.serve --metrics-port`` (port 0 binds an ephemeral
 port, reported back on :attr:`MetricsServer.port`).
+
+Routes: ``/metrics`` (and ``/``) scrape the registry; with providers
+attached, ``/healthz`` answers 200/503 from a
+:class:`mpi4dl_tpu.telemetry.HealthState` snapshot (the load-balancer /
+uptime probe) and ``/debugz`` serves the live diagnostic payload (flight
+recorder tail, watchdog state, latest attribution). ``HEAD`` mirrors
+``GET`` status/headers without a body — probes get 200, not 501 — and
+non-GET/HEAD methods get 405.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -79,11 +88,20 @@ def render_prometheus(registry: MetricsRegistry) -> str:
 
 
 class MetricsServer:
-    """``/metrics`` scrape endpoint on a daemon thread.
+    """``/metrics`` (+ optional ``/healthz``, ``/debugz``) endpoint on a
+    daemon thread.
 
     Binds immediately in the constructor (so an in-use port fails loudly at
     startup, not on the first scrape); ``port=0`` picks an ephemeral port,
     readable from :attr:`port`.
+
+    health: zero-arg callable returning a dict with a boolean
+        ``"healthy"`` key (``HealthState.snapshot``); ``/healthz`` then
+        serves it as JSON with status 200/503. Without it ``/healthz``
+        is 404 like any unknown path.
+    debug: zero-arg callable returning a JSON-serializable diagnostic
+        payload for ``/debugz`` (flight-recorder tail, watchdog state,
+        latest attribution summary).
     """
 
     def __init__(
@@ -91,21 +109,62 @@ class MetricsServer:
         registry: MetricsRegistry,
         port: int = 0,
         host: str = "127.0.0.1",
+        health=None,
+        debug=None,
     ):
         self.registry = registry
+        self.health = health
+        self.debug = debug
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
-                body = render_prometheus(server.registry).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+            def _payload(self):
+                """(status, content-type, body) for GET/HEAD routing."""
+                path = self.path.split("?")[0]
+                if path in ("/metrics", "/"):
+                    return (200, CONTENT_TYPE,
+                            render_prometheus(server.registry).encode())
+                if path == "/healthz" and server.health is not None:
+                    snap = dict(server.health())
+                    status = 200 if snap.get("healthy") else 503
+                    return (status, "application/json",
+                            json.dumps(snap).encode())
+                if path == "/debugz" and server.debug is not None:
+                    return (200, "application/json",
+                            json.dumps(server.debug(), default=str).encode())
+                return (404, "text/plain; charset=utf-8", b"not found\n")
+
+            def _respond(self, send_body: bool):
+                try:
+                    status, ctype, body = self._payload()
+                except Exception as e:  # noqa: BLE001 — a broken debug
+                    # provider must answer 500, not kill the connection
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    body = f"provider error: {e}\n".encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(body)
+                if send_body:
+                    self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                self._respond(send_body=True)
+
+            def do_HEAD(self):  # noqa: N802 — LB/uptime probes use HEAD;
+                self._respond(send_body=False)  # 501 would page someone
+
+            def _method_not_allowed(self):
+                self.send_error(405, "Method Not Allowed")
+
+            # Observability endpoints are read-only: writes are a client
+            # bug, answered 405 (wrong method) rather than 404 (no such
+            # path) or 501 (server can't).
+            do_POST = _method_not_allowed  # noqa: N815
+            do_PUT = _method_not_allowed  # noqa: N815
+            do_DELETE = _method_not_allowed  # noqa: N815
+            do_PATCH = _method_not_allowed  # noqa: N815
+            do_OPTIONS = _method_not_allowed  # noqa: N815
 
             def log_message(self, *a):  # scrapes must not spam stderr
                 pass

@@ -17,6 +17,7 @@ in whatever later reads the log.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -143,6 +144,10 @@ class JsonlWriter:
                 directory, filename or f"telemetry-{os.getpid()}.jsonl"
             )
             self._fh = open(self.path, "a")
+            # Span events flush in batches of FLUSH_EVERY; a process that
+            # exits without close() must still land the final partial
+            # batch on disk.
+            atexit.register(self.close)
 
     @property
     def enabled(self) -> bool:
@@ -172,10 +177,18 @@ class JsonlWriter:
                 self._unflushed = 0
 
     def close(self) -> None:
+        """Flush (the final partial span batch included) and close; safe
+        to call twice — the atexit hook and an explicit close coexist."""
         with self._lock:
             if self._fh is not None:
+                self._fh.flush()
                 self._fh.close()
                 self._fh = None
+                self._unflushed = 0
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
 
 
 def read_events(path: str, validate: bool = True) -> "list[dict]":

@@ -1012,6 +1012,39 @@ class Trainer:
             )
         return reg
 
+    def capture_trace_attribution(
+        self,
+        state,
+        x,
+        y,
+        steps: int = 3,
+        logdir: "str | None" = None,
+        registry=None,
+        program: str = "train_step",
+    ):
+        """Capture an XProf trace of ``steps`` live train steps and
+        attribute device time (:mod:`mpi4dl_tpu.analysis.trace`): per-step
+        compute / collective / transfer / host-gap buckets plus the
+        measured collective-overlap ratio — the runtime cross-check of
+        hlolint's static start→done rule. With a ``registry``, publishes
+        the cataloged ``trace_*`` gauges under ``program``.
+
+        Returns ``(state, summary)`` — the state advances by ``steps``
+        real optimizer updates (the capture measures the genuine step,
+        not a replay)."""
+        from mpi4dl_tpu import profiling
+
+        box = {"state": state}
+
+        def one_step(i):
+            del i
+            box["state"], metrics = self.train_step(box["state"], x, y)
+            return metrics["loss"]
+
+        cap = profiling.capture(one_step, steps=steps, logdir=logdir)
+        summary = cap.attribution(registry=registry, program=program)
+        return box["state"], summary
+
     def remat_report(self) -> dict:
         """Remat/store-budget metadata for the analyzer's effectiveness
         rule: the configured policy + scanq store budget, and the grant

@@ -1,0 +1,313 @@
+"""Flight recorder + watchdog + health endpoints
+(:mod:`mpi4dl_tpu.telemetry.flight` / ``.health``): ring-buffer bounds,
+schema-valid dumps, deterministic trip/recovery logic on a fake clock,
+SIGTERM dump chaining, StepTimer wiring, and the ISSUE fault drill — an
+artificially stalled serving loop trips the watchdog, dumps a
+schema-valid flight-recorder JSONL, and flips ``/healthz`` from 200 to
+503 (then back on recovery). CPU-only, tier-1.
+"""
+
+import glob
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.profiling import StepTimer
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def _marker(i):
+    return {"ts": float(i), "kind": "event", "name": f"m{i}", "attrs": {}}
+
+
+def test_flight_ring_is_bounded_and_tail_ordered():
+    fr = telemetry.FlightRecorder(capacity=8)
+    for i in range(20):
+        fr.record(_marker(i))
+    tail = fr.tail(3)
+    assert [e["name"] for e in tail] == ["m17", "m18", "m19"]
+    assert len(fr.tail(100)) == 8  # ring dropped the oldest 12
+
+
+def test_flight_capacity_zero_disables():
+    fr = telemetry.FlightRecorder(capacity=0)
+    assert not fr.enabled
+    fr.record(_marker(0))
+    assert fr.tail() == []
+    assert fr.dump(reason="manual") is None
+
+
+def test_flight_dump_is_schema_valid_jsonl(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    telemetry.declare(reg, "serve_submitted_total").inc(3)
+    fr = telemetry.FlightRecorder(
+        capacity=32, registry=reg, directory=str(tmp_path)
+    )
+    spans = telemetry.spans_from_marks([("t0", 0.0), ("phase", 0.5)])
+    fr.record(telemetry.span_event("serve.request", "t-1", spans,
+                                   attrs={"outcome": "served"}))
+    fr.record(_marker(1))
+    fr.record({"ts": 2.0, "kind": "bogus"})  # invalid: dropped, counted
+    path = fr.dump(reason="manual")
+    events = telemetry.read_events(path)  # validates every line
+    assert events[-1]["name"] == "flight.dump"
+    assert events[-1]["attrs"]["reason"] == "manual"
+    assert events[-1]["attrs"]["dropped_invalid"] == 1
+    kinds = [e["kind"] for e in events]
+    assert "span" in kinds and "metrics" in kinds  # ring + final snapshot
+    assert reg.get("flight_recorder_dumps_total").value(reason="manual") == 1
+
+
+def test_flight_sigterm_dump_chains_previous_handler(tmp_path):
+    fr = telemetry.FlightRecorder(capacity=8, directory=str(tmp_path))
+    fr.record(_marker(0))
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        assert fr.install_signal_handlers()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5
+        while not hits and time.time() < deadline:
+            time.sleep(0.01)
+        assert hits == [signal.SIGTERM]  # previous handler still ran
+        dumps = glob.glob(str(tmp_path / "flight-*-sigterm.jsonl"))
+        assert len(dumps) == 1
+        assert telemetry.read_events(dumps[0])[-1]["attrs"]["reason"] == (
+            "sigterm"
+        )
+    finally:
+        fr.uninstall_signal_handlers()
+        signal.signal(signal.SIGTERM, prev)
+
+
+# -- watchdog (fake clock: deterministic, no real waits) ----------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_watchdog_trips_only_when_work_is_outstanding_and_stalled():
+    clock = _Clock()
+    reg = telemetry.MetricsRegistry()
+    health = telemetry.HealthState(registry=reg)
+    tripped = []
+    wd = telemetry.Watchdog(
+        factor=2.0, min_timeout_s=1.0, registry=reg, health=health,
+        on_trip=tripped.append, clock=clock, start=False,
+    )
+    # Idle: no amount of elapsed time trips.
+    clock.t += 100
+    assert wd.check() is None
+    # Outstanding work within the timeout: no trip.
+    wd.begin()
+    clock.t += 0.9
+    assert wd.check() is None
+    # Past the timeout: trip once (not once per poll).
+    clock.t += 0.2
+    reason = wd.check()
+    assert reason and "no completion" in reason
+    assert wd.check() is None
+    assert len(tripped) == 1
+    assert not health.healthy
+    assert reg.get("watchdog_trips_total").value() == 1
+    assert reg.get("serve_healthy").value() == 0.0
+    # Completion recovers the health state.
+    wd.done(0.5)
+    assert health.healthy
+    assert reg.get("serve_healthy").value() == 1.0
+    wd.close()
+
+
+def test_watchdog_timeout_adapts_to_rolling_p99():
+    clock = _Clock()
+    wd = telemetry.Watchdog(
+        factor=10.0, min_timeout_s=0.5, clock=clock, start=False,
+    )
+    assert wd.timeout_s() == 0.5  # empty history -> floor
+    wd.seed(0.2)
+    assert wd.timeout_s() == pytest.approx(2.0)  # 10 x p99(0.2)
+    for _ in range(100):
+        wd.begin()
+        wd.done(0.01)
+    assert wd.timeout_s() == pytest.approx(0.5)  # fast again -> floor
+    wd.close()
+
+
+def test_watchdog_cancel_is_not_progress():
+    """A queue-full admission bounce must not reset the stall clock —
+    otherwise a stalled loop behind a churning submit path never trips."""
+    clock = _Clock()
+    wd = telemetry.Watchdog(
+        factor=2.0, min_timeout_s=1.0, clock=clock, start=False,
+    )
+    wd.begin()  # the stuck request
+    clock.t += 0.8
+    wd.begin()
+    wd.cancel()  # admission rejected another request meanwhile
+    clock.t += 0.4  # 1.2s since the stuck request; cancel didn't reset
+    assert wd.check() is not None
+    wd.close()
+
+
+def test_steptimer_reports_to_watchdog():
+    clock = _Clock()
+    wd = telemetry.Watchdog(
+        factor=2.0, min_timeout_s=1.0, clock=clock, start=False,
+    )
+    timer = StepTimer(batch_size=2, warmup=0, watchdog=wd)
+    for _ in range(3):
+        with timer.step():
+            pass
+    st = wd.state()
+    assert st["outstanding"] == 0
+    assert st["history"] == 3  # every step's duration landed
+    wd.close()
+
+
+# -- the ISSUE fault drill ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+
+    size = 16
+    cells = get_resnet_v2(depth=11, num_classes=10, pool_kernel=size // 4)
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+    return cells, params, stats, size
+
+
+def _get_status(url):
+    try:
+        return urllib.request.urlopen(url, timeout=10).status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_serving_fault_drill(engine_parts, tmp_path):
+    """ISSUE acceptance: an artificially stalled serving loop trips the
+    watchdog, dumps a schema-valid flight-recorder JSONL, and flips
+    /healthz from 200 to 503 — then recovers to 200 when the stalled
+    batch finally completes."""
+    from mpi4dl_tpu.serve import ServingEngine
+
+    cells, params, stats, size = engine_parts
+    eng = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3), max_batch=2,
+        default_deadline_s=30.0, metrics_port=0,
+        watchdog_factor=2.0, watchdog_min_timeout_s=0.25,
+        flight_dir=str(tmp_path),
+    )
+    base = f"http://127.0.0.1:{eng.metrics_port}"
+    assert _get_status(f"{base}/healthz") == 200
+
+    # Stall the loop: every bucket executable sleeps well past the
+    # watchdog timeout before doing the real work.
+    orig = dict(eng._compiled)
+
+    def _slow(bucket):
+        def call(p, s, batch):
+            time.sleep(1.5)
+            return orig[bucket](p, s, batch)
+        return call
+
+    eng._compiled = {b: _slow(b) for b in eng.buckets}
+    eng.start()
+    try:
+        x = np.zeros((size, size, 3), np.float32)
+        fut = eng.submit(x, deadline_s=30.0)
+        deadline = time.time() + 5
+        status = 200
+        while status != 503 and time.time() < deadline:
+            status = _get_status(f"{base}/healthz")
+            time.sleep(0.05)
+        assert status == 503, "watchdog never flipped /healthz"
+        assert not eng.health.healthy
+        assert eng.registry.get("watchdog_trips_total").value() == 1
+
+        # The trip dumped the ring as schema-valid JSONL.
+        dumps = glob.glob(str(tmp_path / "flight-*-watchdog.jsonl"))
+        assert len(dumps) == 1
+        events = telemetry.read_events(dumps[0])  # validates every line
+        names = [e.get("name") for e in events]
+        assert "serve.watchdog_trip" in names
+        assert names[-1] == "flight.dump"
+        assert eng.registry.get("flight_recorder_dumps_total").value(
+            reason="watchdog"
+        ) == 1
+
+        # /debugz serves the postmortem context live while unhealthy.
+        dbg = json.loads(
+            urllib.request.urlopen(f"{base}/debugz", timeout=10).read()
+        )
+        assert dbg["watchdog"]["tripped"] is True
+        assert any(
+            e.get("name") == "serve.watchdog_trip" for e in dbg["flight_tail"]
+        )
+
+        # The stalled batch eventually completes: request served,
+        # health self-recovers to 200.
+        assert fut.result(timeout=10).shape == (10,)
+        deadline = time.time() + 5
+        while status != 200 and time.time() < deadline:
+            status = _get_status(f"{base}/healthz")
+            time.sleep(0.05)
+        assert status == 200
+        assert eng.health.healthy
+    finally:
+        eng._compiled = orig
+        eng.stop()
+    assert eng.stats()["healthy"] is True
+
+
+def test_engine_crash_dumps_flight(engine_parts, tmp_path):
+    """A batcher-thread crash (not just a bad batch) flips health and
+    leaves a crash dump for the postmortem."""
+    from mpi4dl_tpu.serve import ServingEngine
+
+    cells, params, stats, size = engine_parts
+    eng = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3), max_batch=2,
+        default_deadline_s=30.0, watchdog_factor=None,
+        flight_dir=str(tmp_path),
+    )
+    # Break the loop itself (batch formation), not one batch's dispatch.
+    eng._form_batch = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    x = np.zeros((size, size, 3), np.float32)
+    fut = eng.submit(x)  # queued before the loop starts (and crashes)
+    eng.start()
+    with pytest.raises(RuntimeError, match="boom|crashed"):
+        fut.result(timeout=10)
+    deadline = time.time() + 5
+    while eng.health.healthy and time.time() < deadline:
+        time.sleep(0.02)
+    assert not eng.health.healthy
+    dumps = glob.glob(str(tmp_path / "flight-*-crash.jsonl"))
+    assert len(dumps) == 1
+    telemetry.read_events(dumps[0])  # schema-valid
+    eng.stop()

@@ -242,6 +242,93 @@ def test_metrics_server_scrape():
         srv.close()
 
 
+def test_metrics_server_head_probe_gets_200():
+    """ISSUE satellite: load-balancer/uptime probes use HEAD — they must
+    get 200 with headers and no body, not http.server's default 501."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("up_total").inc(4)
+    srv = telemetry.MetricsServer(reg, port=0)
+    try:
+        resp = urllib.request.urlopen(
+            urllib.request.Request(srv.url, method="HEAD"), timeout=10
+        )
+        assert resp.status == 200
+        assert int(resp.headers["Content-Length"]) > 0
+        assert resp.read() == b""  # headers only
+    finally:
+        srv.close()
+
+
+def test_metrics_server_non_get_head_is_405():
+    """ISSUE satellite: the endpoints are read-only — writes answer 405
+    (wrong method), not 404 (missing path) or 501 (unimplemented)."""
+    reg = telemetry.MetricsRegistry()
+    srv = telemetry.MetricsServer(reg, port=0)
+    try:
+        for method in ("POST", "PUT", "DELETE"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    srv.url, data=b"x" if method != "DELETE" else None,
+                    method=method,
+                ), timeout=10)
+            assert exc.value.code == 405, method
+    finally:
+        srv.close()
+
+
+def test_metrics_server_healthz_and_debugz():
+    reg = telemetry.MetricsRegistry()
+    state = {"healthy": True, "reason": "ok"}
+    srv = telemetry.MetricsServer(
+        reg, port=0, health=lambda: dict(state),
+        debug=lambda: {"tail": [1, 2, 3]},
+    )
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        assert urllib.request.urlopen(f"{base}/healthz", timeout=10).status == 200
+        dbg = json.loads(
+            urllib.request.urlopen(f"{base}/debugz", timeout=10).read()
+        )
+        assert dbg == {"tail": [1, 2, 3]}
+        state["healthy"] = False
+        state["reason"] = "watchdog tripped"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["reason"] == "watchdog tripped"
+        # HEAD mirrors the status so probes need no body parsing.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/healthz", method="HEAD"), timeout=10)
+        assert exc.value.code == 503
+    finally:
+        srv.close()
+
+
+def test_jsonl_close_flushes_partial_span_batch(tmp_path):
+    """ISSUE satellite: span events flush in batches of 100; a writer
+    closed with a partial batch (7 < 100) must still land every event."""
+    w = telemetry.JsonlWriter(str(tmp_path))
+    spans = telemetry.spans_from_marks([("t0", 0.0), ("phase", 1.0)])
+    for i in range(7):
+        w.write(telemetry.span_event("t", f"id-{i}", spans))
+    w.close()
+    assert len(telemetry.read_events(w.path)) == 7
+    w.close()  # idempotent alongside the atexit hook
+
+
+def test_steptimer_zero_dt_summary_does_not_raise():
+    """ISSUE satellite: a step whose measured dt is 0 (clock too coarse)
+    reports 0.0 img/s — the telemetry gauge's convention — instead of
+    ZeroDivisionError inside summary()."""
+    timer = StepTimer(batch_size=4, warmup=0)
+    timer.times[:] = [0.0, 0.1]
+    assert timer.images_per_sec == [0.0, 40.0]
+    s = timer.summary()
+    assert s["steps"] == 2
+    assert s["images_per_sec_mean"] == 20.0
+
+
 # -- catalog gates: docs <-> catalog <-> what the stack exposes ---------------
 
 _DOC_ROW = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|([^|]+)\|([^|]+)\|")
@@ -346,6 +433,38 @@ def full_stack(tmp_path_factory):
     trainer.publish_telemetry(
         reg, params=params, x_shape=(2, size, size, 3)
     )
+
+    # Trace-attribution publisher (profiling.capture -> analysis.trace):
+    # a ppermute ring on the CPU mesh so the capture carries collective
+    # slices and the overlap-ratio gauge gets a value.
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mpi4dl_tpu import profiling
+    from mpi4dl_tpu.analysis.trace import publish_attribution
+    from mpi4dl_tpu.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    n = len(jax.devices())
+
+    def body(v):
+        w = jax.lax.ppermute(v, "x", [(i, (i + 1) % n) for i in range(n)])
+        m = v[0]
+        return v * (m @ m.T).sum() + w
+
+    g = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+    ))
+    v = jnp.ones((n, 64, 64))
+    g(v).block_until_ready()
+    cap = profiling.capture(
+        lambda i: g(v), steps=3, logdir=str(tmp_path_factory.mktemp("tr"))
+    )
+    summary = cap.attribution()
+    if summary["collective"]["overlap_ratio"] is None:
+        # tiny programs can finish their collectives with no concurrent
+        # compute sampled; the gauge must still be exercised
+        summary["collective"]["overlap_ratio"] = 0.0
+    publish_attribution(summary, reg, program="unit")
 
     events = telemetry.read_events(
         os.path.join(tdir, os.listdir(tdir)[0])
