@@ -355,10 +355,22 @@ def _measure_serving() -> dict:
         cells, params,
         [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
     )
+    from mpi4dl_tpu.telemetry import SLOConfig
+
     engine = ServingEngine(
         cells, params, stats, example_shape=(size, size, 3),
         buckets=(1, 32), max_wait_s=0.003, max_queue=512,
         default_deadline_s=30.0, registry=_REGISTRY,
+        # SLO evaluation on so every serving result line carries a
+        # verdict (docs/OBSERVABILITY.md "SLOs & alerting"); interval
+        # shortened because the whole load run lasts ~a second. A tight
+        # availability objective with a loose latency threshold: the CPU
+        # bench must flag dropped/rejected requests, not page on a slow
+        # shared box.
+        slo=SLOConfig(
+            availability=0.999, latency_threshold_s=2.5,
+            latency_target=0.99, interval_s=0.25,
+        ),
     )
     serial = serial_throughput(engine, 32)
     attribute = os.environ.get("BENCH_ATTRIBUTION", "1") != "0"
@@ -395,6 +407,7 @@ def _measure_serving() -> dict:
         "deadline_misses": rep["deadline_misses"],
         "rejected": rep["rejected_queue_full"],
         "lint_ok": lint.ok,
+        "slo": engine.slo.verdict(),
     }
     if attribution is not None:
         entry["attribution"] = attribution

@@ -1,0 +1,204 @@
+"""``python -m mpi4dl_tpu.analyze bench-history BENCH_r*.json`` — the
+perf-trajectory comparator over committed bench round files.
+
+The repo accumulates one ``BENCH_rNN.json`` per round (driver shape:
+``{"n": round, "rc": exit, "parsed": <last bench.py result line | null>,
+...}``), but nothing *read* the trajectory — hlolint got a baseline gate
+in PR 1 while throughput regressions could only be spotted by eyeballing
+JSON. This module is the reader: it extracts every measured series from
+every round (headline + extras values, peak-pixels capability), prints a
+per-key trend table, and renders a regression verdict for the latest
+round against the most recent previous round that measured the same key,
+with a relative tolerance band. CI-friendly: exit 1 on any regression
+(or when the latest round produced no parsed result at all), 0 otherwise.
+
+Keys whose history ends before the latest round ("gone" — a renamed
+metric or a skipped extra) are reported but do not fail by default;
+``--strict`` makes them regressions too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.analyze bench-history",
+        description="Perf-trajectory comparison over BENCH_r*.json rounds",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("files", nargs="+",
+                   help="bench round files (BENCH_r*.json), any order — "
+                        "sorted by their recorded round number")
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="relative band: latest < previous * (1 - tol) "
+                        "is a regression, > previous * (1 + tol) an "
+                        "improvement, else flat")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on keys measured previously but "
+                        "absent from the latest round")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="write the machine-readable comparison here")
+    return p
+
+
+def _load_round(path: str) -> dict:
+    """One round file → {"n": int|None, "rc": int|None, "result": dict|None}.
+    Accepts either the driver wrapper ({"n", "rc", "parsed", ...}) or a
+    bare bench.py result line ({"metric", "value", ...})."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if "parsed" in data or "n" in data:
+        return {
+            "path": path,
+            "n": data.get("n"),
+            "rc": data.get("rc"),
+            "result": data.get("parsed") or None,
+        }
+    if "metric" in data:
+        return {"path": path, "n": None, "rc": None, "result": data}
+    raise ValueError(
+        f"{path}: neither a bench round wrapper nor a result line"
+    )
+
+
+def extract_series(result: dict) -> "dict[str, float]":
+    """Comparable numeric series of one parsed result line: the headline
+    throughput under its metric name, every extra's ``value``, and the
+    peak-pixels capability point."""
+    out: dict[str, float] = {}
+    if result.get("metric") and isinstance(result.get("value"), (int, float)):
+        out[result["metric"]] = float(result["value"])
+    for name, entry in (result.get("extras") or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        if isinstance(entry.get("value"), (int, float)):
+            out[name] = float(entry["value"])
+        peak = entry.get("peak_trainable_px_per_chip")
+        if isinstance(peak, (int, float)):
+            out[f"{name}.peak_px"] = float(peak)
+    return out
+
+
+def compare(rounds: "list[dict]", tolerance: float, strict: bool) -> dict:
+    """Trend + verdicts over loaded rounds (sorted by round number, file
+    order breaking ties). ``rounds`` entries are ``_load_round`` outputs."""
+    ordered = sorted(
+        enumerate(rounds), key=lambda it: (
+            it[1]["n"] if isinstance(it[1]["n"], int) else it[0], it[0]
+        )
+    )
+    rounds = [r for _, r in ordered]
+    labels = [
+        f"r{r['n']:02d}" if isinstance(r["n"], int) else f"#{i}"
+        for i, r in enumerate(rounds)
+    ]
+    history: "dict[str, list]" = {}
+    for i, r in enumerate(rounds):
+        if not r["result"]:
+            continue
+        for key, val in extract_series(r["result"]).items():
+            history.setdefault(key, [None] * len(rounds))
+            history[key][i] = val
+
+    latest = len(rounds) - 1
+    keys = []
+    n_regressed = 0
+    for key in sorted(history):
+        vals = history[key]
+        cur = vals[latest]
+        prev = next(
+            (v for v in reversed(vals[:latest]) if v is not None), None
+        )
+        if cur is None:
+            verdict = "gone" if prev is not None else "never"
+            regressed = strict and prev is not None
+        elif prev is None:
+            verdict, regressed = "new", False
+        elif cur < prev * (1 - tolerance):
+            verdict, regressed = "regressed", True
+        elif cur > prev * (1 + tolerance):
+            verdict, regressed = "improved", False
+        else:
+            verdict, regressed = "flat", False
+        n_regressed += bool(regressed)
+        keys.append({
+            "key": key,
+            "values": vals,
+            "latest": cur,
+            "previous": prev,
+            "delta_pct": (
+                (cur - prev) / prev * 100.0
+                if cur is not None and prev else None
+            ),
+            "verdict": verdict,
+            "regressed": bool(regressed),
+        })
+
+    latest_ok = bool(rounds and rounds[latest]["result"])
+    return {
+        "rounds": labels,
+        "files": [r["path"] for r in rounds],
+        "tolerance": tolerance,
+        "latest_has_result": latest_ok,
+        "keys": keys,
+        "regressions": n_regressed,
+        "ok": latest_ok and n_regressed == 0,
+    }
+
+
+def render_table(cmp: dict) -> str:
+    labels = cmp["rounds"]
+    width = max([len(k["key"]) for k in cmp["keys"]] + [4])
+    head = (
+        f"{'key':<{width}}  "
+        + "  ".join(f"{lb:>9}" for lb in labels)
+        + f"  {'Δ prev':>8}  verdict"
+    )
+    lines = [head, "-" * len(head)]
+    for k in cmp["keys"]:
+        cells = "  ".join(
+            f"{v:>9.3f}" if v is not None else f"{'-':>9}"
+            for v in k["values"]
+        )
+        delta = (
+            f"{k['delta_pct']:>+7.1f}%" if k["delta_pct"] is not None
+            else f"{'-':>8}"
+        )
+        lines.append(f"{k['key']:<{width}}  {cells}  {delta}  {k['verdict']}")
+    lines.append(
+        f"{cmp['regressions']} regression(s) at tolerance "
+        f"{cmp['tolerance']:.0%}"
+        + ("" if cmp["latest_has_result"]
+           else " — and the latest round has NO parsed result")
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = []
+    for pat in args.files:
+        hits = sorted(globmod.glob(pat))
+        paths.extend(hits if hits else [pat])  # unmatched: open() reports
+    rounds = [_load_round(p) for p in paths]
+    if not rounds:
+        print("no round files", file=sys.stderr)
+        return 2
+    cmp = compare(rounds, args.tolerance, args.strict)
+    print(render_table(cmp))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(cmp, f, indent=2)
+            f.write("\n")
+    return 0 if cmp["ok"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via analyze.py
+    sys.exit(main())

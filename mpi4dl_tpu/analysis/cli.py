@@ -13,6 +13,10 @@ Examples::
         --size 512 --json /tmp/r.json
     python -m mpi4dl_tpu.analyze --model amoebanet --size 64 --dp 2
     python -m mpi4dl_tpu.analyze --model resnet --size 512 --write-baseline
+
+One subcommand: ``python -m mpi4dl_tpu.analyze bench-history
+BENCH_r*.json`` compares the committed bench rounds and fails on a
+throughput regression (:mod:`mpi4dl_tpu.analysis.bench_history`).
 """
 
 from __future__ import annotations
@@ -132,6 +136,13 @@ def _config_key(args, platform: str) -> str:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "bench-history":
+        # Pure-JSON subcommand: no jax, no devices, no compile — safe to
+        # dispatch before any backend setup.
+        from mpi4dl_tpu.analysis.bench_history import main as bench_history
+
+        return bench_history(argv[1:])
     args = build_parser().parse_args(argv)
 
     from mpi4dl_tpu.utils import apply_platform_env, enable_compilation_cache

@@ -22,7 +22,11 @@ Entry points:
 Fully instrumented through :mod:`mpi4dl_tpu.telemetry`: request-lifecycle
 spans, outcome/queue-depth/bucket-occupancy metrics, an opt-in Prometheus
 scrape endpoint (``metrics_port=`` / ``--metrics-port``) and JSONL span
-log (``MPI4DL_TPU_TELEMETRY_DIR``).
+log (``MPI4DL_TPU_TELEMETRY_DIR``) — and, with an
+:class:`~mpi4dl_tpu.telemetry.SLOConfig` (``slo=`` /
+``--slo-availability`` / ``--slo-latency-ms``), continuous SLO
+evaluation: error-budget burn-rate alerting on ``/alertz`` and the
+advisory ``autoscale_desired_replicas`` fleet signal.
 
 See ``docs/SERVING.md`` for architecture, bucket policy, and deadline
 semantics; ``docs/OBSERVABILITY.md`` for the metric catalog.

@@ -12,6 +12,8 @@ Examples::
     JAX_PLATFORMS=cpu python -m mpi4dl_tpu.serve --requests 64
     python -m mpi4dl_tpu.serve --ckpt /ckpts/run1 --mode open \
         --rate 200 --duration 10 --deadline-ms 50 --lint
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.serve --requests 512 \
+        --slo-availability 99.9 --slo-latency-ms 50 --metrics-port 0
 """
 
 from __future__ import annotations
@@ -75,6 +77,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flight-dir", default=None,
                    help="where watchdog/crash/SIGTERM flight dumps land "
                         "(default: the telemetry dir, then the temp dir)")
+    p.add_argument("--slo-availability", type=float, default=None,
+                   metavar="PCT",
+                   help="availability SLO target in percent (e.g. 99.9): "
+                        "good outcomes / all outcomes of "
+                        "serve_requests_total; enables the SLO evaluator, "
+                        "burn-rate alerts, /alertz, and the advisory "
+                        "autoscale gauge")
+    p.add_argument("--slo-latency-ms", type=float, default=None,
+                   metavar="MS",
+                   help="latency SLO threshold: --slo-latency-target "
+                        "percent of served requests must finish within "
+                        "this many milliseconds (e2e)")
+    p.add_argument("--slo-latency-target", type=float, default=99.0,
+                   metavar="PCT",
+                   help="latency SLO target in percent")
+    p.add_argument("--slo-interval", type=float, default=1.0,
+                   help="SLO evaluator tick, seconds")
     p.add_argument("--trace-dir", default=None,
                    help="capture an XProf trace of the load run here and "
                         "attribute device time per serve batch "
@@ -123,7 +142,30 @@ def _liveness_kw(args) -> dict:
         "watchdog_min_timeout_s": args.watchdog_min_timeout,
         "flight_capacity": args.flight_capacity,
         "flight_dir": args.flight_dir,
+        "slo": _slo_config(args),
     }
+
+
+def _slo_config(args):
+    """``--slo-availability 99.9 --slo-latency-ms 50`` → SLOConfig (CLI
+    speaks percent, the library speaks ratios); None when neither
+    objective is requested."""
+    if args.slo_availability is None and args.slo_latency_ms is None:
+        return None
+    from mpi4dl_tpu.telemetry import SLOConfig
+
+    return SLOConfig(
+        availability=(
+            args.slo_availability / 100.0
+            if args.slo_availability is not None else None
+        ),
+        latency_threshold_s=(
+            args.slo_latency_ms / 1e3
+            if args.slo_latency_ms is not None else None
+        ),
+        latency_target=args.slo_latency_target / 100.0,
+        interval_s=args.slo_interval,
+    )
 
 
 def main(argv=None) -> int:
@@ -164,9 +206,12 @@ def main(argv=None) -> int:
         report["metrics_port"] = engine.metrics_port
         # stderr, not stdout: the stdout protocol is "keep the last JSON
         # line", and the scrape URL must be visible while the run is live.
+        endpoints = "/healthz, /debugz" + (
+            ", /alertz" if engine.slo is not None else ""
+        )
         print(
             f"# metrics: http://127.0.0.1:{engine.metrics_port}/metrics "
-            f"(also /healthz, /debugz)",
+            f"(also {endpoints})",
             file=sys.stderr, flush=True,
         )
     if args.serial:
@@ -216,6 +261,9 @@ def main(argv=None) -> int:
             report["attribution"] = {
                 "error": f"{type(e).__name__}: {str(e)[:160]}"
             }
+
+    if engine.slo is not None:
+        report["slo"] = engine.slo.verdict()
 
     if args.serial and report["serial"]["throughput_rps"] > 0:
         report["speedup_vs_serial"] = (

@@ -121,6 +121,14 @@ class ServingEngine:
     flight_dir: where watchdog/crash dumps land; defaults to the
         telemetry dir, then ``MPI4DL_TPU_TELEMETRY_DIR``, then the
         system temp dir.
+    slo: a :class:`telemetry.SLOConfig` — declarative availability /
+        latency objectives. When set (with at least one objective), a
+        daemon :class:`telemetry.SLOEvaluator` snapshots the registry
+        every ``interval_s``, computes multi-window burn rates, runs the
+        ``pending → firing → resolved`` alert machines (transitions land
+        in the JSONL log and the flight ring), drives the advisory
+        autoscaler, and serves it all on ``/alertz`` (:attr:`slo`).
+        None (default) runs no evaluator.
     """
 
     def __init__(
@@ -142,6 +150,7 @@ class ServingEngine:
         watchdog_min_timeout_s: float = 2.0,
         flight_capacity: int = 512,
         flight_dir: "str | None" = None,
+        slo=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -239,10 +248,30 @@ class ServingEngine:
             # meaningful before the first served request.
             self.watchdog.seed(max(self.warm_latency_s.values()))
 
+        # -- SLO evaluation (telemetry/slo.py, alerts.py, autoscale.py) -----
+        self.slo: "telemetry.SLOEvaluator | None" = None
+        if slo is not None:
+            objectives = slo.objectives()
+            if objectives:
+                autoscaler = telemetry.Autoscaler(
+                    registry=self.registry,
+                    config=slo.autoscale,
+                    queue_capacity=max_queue,
+                )
+                self.slo = telemetry.SLOEvaluator(
+                    registry=self.registry,
+                    objectives=objectives,
+                    config=slo,
+                    autoscaler=autoscaler,
+                    events=self._events,
+                    flight=self.flight,
+                )
+
         self._server = (
             telemetry.MetricsServer(
                 self.registry, port=metrics_port,
                 health=self.health.snapshot, debug=self._debugz,
+                alerts=self.slo.state if self.slo is not None else None,
             )
             if metrics_port is not None
             else None
@@ -295,6 +324,8 @@ class ServingEngine:
             return
         self._stop_evt.clear()
         self._record_marker("serve.start")
+        if self.slo is not None:
+            self.slo.start()
         self._thread = threading.Thread(
             target=self._loop, name="mpi4dl-serve-batcher", daemon=True
         )
@@ -316,6 +347,14 @@ class ServingEngine:
         # stays dumpable.
         if self.watchdog is not None:
             self.watchdog.close()
+        if self.slo is not None:
+            # Final evaluation so the last requests' outcomes reach the
+            # gauges/verdict before the evaluator thread stops.
+            self.slo.close()
+            try:
+                self.slo.evaluate_once()
+            except Exception:  # noqa: BLE001 — the verdict is advisory
+                pass
         if self._server is not None:
             self._server.close()
             self._server = None
@@ -420,6 +459,7 @@ class ServingEngine:
             "stats": self.stats(),
             "health": self.health.snapshot(),
             "watchdog": self.watchdog.state() if self.watchdog else None,
+            "slo": self.slo.state() if self.slo is not None else None,
             "flight_tail": self.flight.tail(50),
             "attribution": self.last_attribution,
         }

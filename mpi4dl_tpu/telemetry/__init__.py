@@ -24,7 +24,15 @@ signals from. Four pieces:
   SIGTERM (the postmortem story);
 - :mod:`.health` — :class:`HealthState` behind the ``/healthz`` endpoint
   and the :class:`Watchdog` that flips it on hung-step / stalled-loop
-  detection.
+  detection;
+- :mod:`.windows` — bounded ring of registry snapshots answering
+  Prometheus-shaped ``rate``/``increase``/availability queries in-process;
+- :mod:`.slo` — declarative SLO objectives, error budgets, and
+  Google-SRE multi-window multi-burn-rate math;
+- :mod:`.alerts` — the ``pending → firing → resolved`` alert state
+  machine and the daemon :class:`SLOEvaluator` behind ``/alertz``;
+- :mod:`.autoscale` — advisory fleet signals: windowed pressure →
+  the ``autoscale_desired_replicas`` gauge.
 
 Who publishes what: ``serve.ServingEngine`` (request outcomes, queue
 depth, bucket occupancy, pad waste, latency + lifecycle spans),
@@ -37,6 +45,14 @@ depth, bucket occupancy, pad waste, latency + lifecycle spans),
 
 import threading
 
+from mpi4dl_tpu.telemetry.alerts import (  # noqa: F401
+    AlertState,
+    SLOEvaluator,
+)
+from mpi4dl_tpu.telemetry.autoscale import (  # noqa: F401
+    AutoscaleConfig,
+    Autoscaler,
+)
 from mpi4dl_tpu.telemetry.catalog import (  # noqa: F401
     CATALOG,
     MetricSpec,
@@ -65,6 +81,14 @@ from mpi4dl_tpu.telemetry.registry import (  # noqa: F401
     MetricsRegistry,
     Reservoir,
 )
+from mpi4dl_tpu.telemetry.slo import (  # noqa: F401
+    BurnWindow,
+    Objective,
+    SLOConfig,
+    availability_objective,
+    latency_objective,
+)
+from mpi4dl_tpu.telemetry.windows import SnapshotWindow  # noqa: F401
 from mpi4dl_tpu.telemetry.spans import (  # noqa: F401
     new_trace_id,
     record_spans,

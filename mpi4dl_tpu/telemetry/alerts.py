@@ -1,0 +1,321 @@
+"""Alert state machine + the in-process SLO evaluator thread.
+
+The T3 lesson applied to alerting: evaluation runs *inside* the engine
+against the live registry, continuously, instead of assuming an external
+Prometheus deployment the single-process serving story doesn't have.
+A daemon :class:`SLOEvaluator` ticks every ``interval_s``: one registry
+snapshot into the :class:`~mpi4dl_tpu.telemetry.windows.SnapshotWindow`,
+then for every :class:`~mpi4dl_tpu.telemetry.slo.Objective` × burn
+window it computes long/short burn rates, publishes the cataloged
+``slo_error_budget_remaining`` / ``slo_burn_rate`` / ``alert_active``
+series, steps each alert's state machine, and drives the advisory
+autoscaler (:mod:`mpi4dl_tpu.telemetry.autoscale`).
+
+Alert lifecycle (Prometheus-shaped)::
+
+    inactive ──condition──▶ pending ──held for_s──▶ firing
+        ▲                      │ condition clears      │ condition clears
+        └──────(cancelled)─────┴───────(resolved)──────┘
+
+Every transition is emitted as a schema-valid JSONL ``event``
+(``name="alert.transition"``) into the engine's event log (when enabled)
+and ALWAYS into the flight-recorder ring — a postmortem dump shows the
+alert history interleaved with the request spans that caused it.
+
+Clock and ticking are injectable (``start=False`` +
+:meth:`SLOEvaluator.evaluate_once`) so the trip math is unit-testable
+with hand-computed golden values and no real waits.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from mpi4dl_tpu.telemetry import slo as slo_mod
+from mpi4dl_tpu.telemetry.windows import SnapshotWindow
+
+STATES = ("inactive", "pending", "firing")
+
+
+class AlertState:
+    """One alert's ``inactive → pending → firing`` machine.
+
+    ``step(active, now)`` returns the transition ``(old, new)`` when the
+    state changed, else None. ``for_s`` is the hold time between the
+    condition first turning true and the alert firing; 0 fires on the
+    first true evaluation.
+    """
+
+    def __init__(self, name: str, severity: str, for_s: float = 0.0):
+        self.name = name
+        self.severity = severity
+        self.for_s = float(for_s)
+        self.state = "inactive"
+        self.since: "float | None" = None     # state entry time
+        self.pending_since: "float | None" = None
+        self.fired_count = 0
+
+    def step(self, active: bool, now: float):
+        old = self.state
+        if active:
+            if self.state == "inactive":
+                self.pending_since = now
+                if self.for_s <= 0:
+                    self.state = "firing"
+                    self.fired_count += 1
+                else:
+                    self.state = "pending"
+            elif self.state == "pending":
+                if now - self.pending_since >= self.for_s:
+                    self.state = "firing"
+                    self.fired_count += 1
+        else:
+            if self.state in ("pending", "firing"):
+                self.state = "inactive"
+                self.pending_since = None
+        if self.state != old:
+            self.since = now
+            return (old, self.state)
+        return None
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name,
+            "severity": self.severity,
+            "state": self.state,
+            "for_s": self.for_s,
+            "since": self.since,
+            "fired_count": self.fired_count,
+        }
+
+
+class SLOEvaluator:
+    """Continuous SLO evaluation over the live registry.
+
+    registry: the shared :class:`MetricsRegistry` (read for snapshots,
+        written for the ``slo_*`` / ``alert_active`` series — all
+        declared up front so the catalog pin sees them from tick zero).
+    objectives: :class:`~mpi4dl_tpu.telemetry.slo.Objective` list
+        (usually ``SLOConfig.objectives()``).
+    config: the :class:`~mpi4dl_tpu.telemetry.slo.SLOConfig` supplying
+        burn windows / for_s / interval / ring capacity.
+    autoscaler: optional :class:`~mpi4dl_tpu.telemetry.autoscale.
+        Autoscaler`, driven once per tick with the page-window burn.
+    events: optional :class:`JsonlWriter` for transition events.
+    flight: optional :class:`FlightRecorder`; transitions enter the ring.
+    clock: injectable monotonic clock; ``start=False`` skips the daemon
+        thread (tests call :meth:`evaluate_once`).
+    """
+
+    def __init__(
+        self,
+        registry,
+        objectives,
+        config,
+        autoscaler=None,
+        events=None,
+        flight=None,
+        clock=time.monotonic,
+        start: bool = False,
+    ):
+        from mpi4dl_tpu import telemetry
+
+        self.registry = registry
+        self.objectives = list(objectives)
+        self.config = config
+        self.autoscaler = autoscaler
+        self._events = events
+        self._flight = flight
+        self._clock = clock
+        self.window = SnapshotWindow(
+            registry, capacity=config.ring_capacity(), clock=clock
+        )
+        self._m_budget = telemetry.declare(
+            registry, "slo_error_budget_remaining"
+        )
+        self._m_burn = telemetry.declare(registry, "slo_burn_rate")
+        self._m_active = telemetry.declare(registry, "alert_active")
+        self.alerts: "dict[str, AlertState]" = {}
+        for obj in self.objectives:
+            for bw in config.burn_windows:
+                name = f"{obj.name}_{bw.name}_burn"
+                self.alerts[name] = AlertState(
+                    name, bw.severity, for_s=config.for_s
+                )
+                self._m_active.set(0.0, alert=name, severity=bw.severity)
+        self.transitions: collections.deque = collections.deque(maxlen=256)
+        self._last_burns: dict = {}
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        if start:
+            self.start()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mpi4dl-slo-evaluator", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.config.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:  # noqa: BLE001 — a broken evaluation must
+                pass  # not kill the serving loop's sidecar thread
+
+    # -- evaluation -----------------------------------------------------------
+
+    def evaluate_once(self, now: "float | None" = None) -> dict:
+        """One tick: snapshot, burn rates, gauges, alert transitions,
+        autoscale. Returns the burn map (tests read the golden values)."""
+        now = self._clock() if now is None else float(now)
+        self.window.record(now)
+        burns: dict = {}
+        page_burn = None
+        for obj in self.objectives:
+            rem = slo_mod.budget_remaining(self.registry, obj)
+            if rem is not None:
+                self._m_budget.set(rem, slo=obj.name)
+            for bw in self.config.burn_windows:
+                b_long = slo_mod.burn_rate(self.window, obj, bw.long_s)
+                b_short = slo_mod.burn_rate(self.window, obj, bw.short_s)
+                burns[(obj.name, bw.name)] = (b_long, b_short)
+                if b_long is not None:
+                    self._m_burn.set(
+                        b_long, slo=obj.name, window=f"{bw.name}_long"
+                    )
+                if b_short is not None:
+                    self._m_burn.set(
+                        b_short, slo=obj.name, window=f"{bw.name}_short"
+                    )
+                if bw.severity == "page" and b_long is not None:
+                    page_burn = (
+                        b_long if page_burn is None else max(page_burn, b_long)
+                    )
+                active = (
+                    b_long is not None and b_short is not None
+                    and b_long > bw.factor and b_short > bw.factor
+                )
+                name = f"{obj.name}_{bw.name}_burn"
+                st = self.alerts[name]
+                moved = st.step(active, now)
+                self._m_active.set(
+                    1.0 if st.state == "firing" else 0.0,
+                    alert=name, severity=st.severity,
+                )
+                if moved is not None:
+                    self._emit_transition(
+                        st, moved, obj, bw, b_long, b_short
+                    )
+        with self._lock:
+            self._last_burns = dict(burns)
+        if self.autoscaler is not None:
+            self.autoscaler.update(now, self.window, page_burn)
+        return burns
+
+    def _emit_transition(self, st, moved, obj, bw, b_long, b_short) -> None:
+        old, new = moved
+        ev = {
+            "ts": time.time(),
+            "kind": "event",
+            "name": "alert.transition",
+            "attrs": {
+                "alert": st.name,
+                "severity": st.severity,
+                "from": old,
+                "to": new,
+                "slo": obj.name,
+                "objective": obj.target,
+                "factor": bw.factor,
+                "burn_long": b_long,
+                "burn_short": b_short,
+                "window_long_s": bw.long_s,
+                "window_short_s": bw.short_s,
+            },
+        }
+        self.transitions.append(ev)
+        if self._flight is not None:
+            self._flight.record(ev)
+        if self._events is not None:
+            self._events.write(ev)
+
+    # -- surfaces -------------------------------------------------------------
+
+    def state(self) -> dict:
+        """The ``/alertz`` payload: objectives + budgets + burns, alert
+        states, recent transitions, autoscale view."""
+        with self._lock:
+            burns = dict(self._last_burns)
+        slos = []
+        for obj in self.objectives:
+            entry = {
+                "slo": obj.name,
+                "kind": obj.kind,
+                "objective": obj.target,
+                "metric": obj.metric,
+                "sli_cumulative": slo_mod.cumulative_sli(self.registry, obj),
+                "error_budget_remaining": slo_mod.budget_remaining(
+                    self.registry, obj
+                ),
+                "burn": {
+                    bw.name: {
+                        "long": burns.get((obj.name, bw.name), (None, None))[0],
+                        "short": burns.get((obj.name, bw.name), (None, None))[1],
+                        "factor": bw.factor,
+                        "long_s": bw.long_s,
+                        "short_s": bw.short_s,
+                        "severity": bw.severity,
+                    }
+                    for bw in self.config.burn_windows
+                },
+            }
+            if obj.kind == "latency":
+                entry["threshold_s"] = obj.threshold_s
+            slos.append(entry)
+        return {
+            "slos": slos,
+            "alerts": [a.snapshot() for a in self.alerts.values()],
+            "transitions": list(self.transitions)[-20:],
+            "autoscale": (
+                self.autoscaler.state() if self.autoscaler is not None
+                else None
+            ),
+            "window": {
+                "snapshots": len(self.window),
+                "span_s": self.window.span_s(),
+            },
+        }
+
+    def verdict(self) -> dict:
+        """Compact end-of-run verdict (bench.py result lines): ok iff no
+        page alert ever fired and every budget ends non-negative."""
+        out = {"ok": True, "slos": {}, "alerts_fired": {}}
+        for obj in self.objectives:
+            rem = slo_mod.budget_remaining(self.registry, obj)
+            out["slos"][obj.name] = {
+                "objective": obj.target,
+                "sli": slo_mod.cumulative_sli(self.registry, obj),
+                "budget_remaining": rem,
+            }
+            if rem is not None and rem < 0:
+                out["ok"] = False
+        for a in self.alerts.values():
+            if a.fired_count:
+                out["alerts_fired"][a.name] = a.fired_count
+                if a.severity == "page":
+                    out["ok"] = False
+        return out

@@ -96,6 +96,29 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Flight-recorder postmortem dumps, by trigger: watchdog, crash, "
         "sigterm, manual.",
     ),
+    # -- SLO engine (mpi4dl_tpu/telemetry/slo.py, alerts.py, autoscale.py) ---
+    "slo_error_budget_remaining": MetricSpec(
+        "gauge", ("slo",),
+        "Fraction of the error budget left over the process lifetime: "
+        "1 = untouched, 0 = exactly spent, negative = objective violated.",
+    ),
+    "slo_burn_rate": MetricSpec(
+        "gauge", ("slo", "window"),
+        "Error-budget burn rate per objective and burn window "
+        "(fast_long/fast_short/slow_long/slow_short); 1.0 spends exactly "
+        "the budget over the SLO period.",
+    ),
+    "alert_active": MetricSpec(
+        "gauge", ("alert", "severity"),
+        "1 while the burn-rate alert is firing (pending and resolved are "
+        "0) — the scrapeable twin of /alertz.",
+    ),
+    "autoscale_desired_replicas": MetricSpec(
+        "gauge", (),
+        "Advisory replica count a fleet controller should run, from "
+        "windowed queue depth + rejection rate + page burn with "
+        "hysteresis and cooldown (telemetry/autoscale.py).",
+    ),
     # -- trace attribution (mpi4dl_tpu/analysis/trace.py) --------------------
     "trace_attribution_seconds": MetricSpec(
         "gauge", ("program", "category"),

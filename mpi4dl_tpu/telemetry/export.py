@@ -9,11 +9,14 @@ daemon ``http.server`` thread — stdlib only (the container must not need
 ``python -m mpi4dl_tpu.serve --metrics-port`` (port 0 binds an ephemeral
 port, reported back on :attr:`MetricsServer.port`).
 
-Routes: ``/metrics`` (and ``/``) scrape the registry; with providers
+Routes: ``/metrics`` scrapes the registry; ``/`` returns a small text
+index of the endpoints this server actually has (an operator probing the
+port discovers the surface instead of guessing paths); with providers
 attached, ``/healthz`` answers 200/503 from a
 :class:`mpi4dl_tpu.telemetry.HealthState` snapshot (the load-balancer /
-uptime probe) and ``/debugz`` serves the live diagnostic payload (flight
-recorder tail, watchdog state, latest attribution). ``HEAD`` mirrors
+uptime probe), ``/debugz`` serves the live diagnostic payload (flight
+recorder tail, watchdog state, latest attribution), and ``/alertz``
+serves the SLO evaluator's alert/burn/budget state. ``HEAD`` mirrors
 ``GET`` status/headers without a body — probes get 200, not 501 — and
 non-GET/HEAD methods get 405.
 """
@@ -88,8 +91,8 @@ def render_prometheus(registry: MetricsRegistry) -> str:
 
 
 class MetricsServer:
-    """``/metrics`` (+ optional ``/healthz``, ``/debugz``) endpoint on a
-    daemon thread.
+    """``/metrics`` (+ ``/`` index, optional ``/healthz``, ``/debugz``,
+    ``/alertz``) endpoint on a daemon thread.
 
     Binds immediately in the constructor (so an in-use port fails loudly at
     startup, not on the first scrape); ``port=0`` picks an ephemeral port,
@@ -102,6 +105,8 @@ class MetricsServer:
     debug: zero-arg callable returning a JSON-serializable diagnostic
         payload for ``/debugz`` (flight-recorder tail, watchdog state,
         latest attribution summary).
+    alerts: zero-arg callable returning the SLO/alert state payload for
+        ``/alertz`` (``SLOEvaluator.state``).
     """
 
     def __init__(
@@ -111,17 +116,22 @@ class MetricsServer:
         host: str = "127.0.0.1",
         health=None,
         debug=None,
+        alerts=None,
     ):
         self.registry = registry
         self.health = health
         self.debug = debug
+        self.alerts = alerts
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def _payload(self):
                 """(status, content-type, body) for GET/HEAD routing."""
                 path = self.path.split("?")[0]
-                if path in ("/metrics", "/"):
+                if path == "/":
+                    return (200, "text/plain; charset=utf-8",
+                            server._index().encode())
+                if path == "/metrics":
                     return (200, CONTENT_TYPE,
                             render_prometheus(server.registry).encode())
                 if path == "/healthz" and server.health is not None:
@@ -132,6 +142,9 @@ class MetricsServer:
                 if path == "/debugz" and server.debug is not None:
                     return (200, "application/json",
                             json.dumps(server.debug(), default=str).encode())
+                if path == "/alertz" and server.alerts is not None:
+                    return (200, "application/json",
+                            json.dumps(server.alerts(), default=str).encode())
                 return (404, "text/plain; charset=utf-8", b"not found\n")
 
             def _respond(self, send_body: bool):
@@ -179,6 +192,25 @@ class MetricsServer:
             daemon=True,
         )
         self._thread.start()
+
+    def _index(self) -> str:
+        """The ``/`` endpoint index: only routes this server actually
+        answers (operators probing the port discover the surface)."""
+        lines = [
+            "mpi4dl_tpu telemetry endpoints:",
+            "  /metrics  Prometheus text exposition (0.0.4)",
+        ]
+        if self.health is not None:
+            lines.append("  /healthz  liveness JSON, 200 healthy / 503 not")
+        if self.debug is not None:
+            lines.append(
+                "  /debugz   diagnostics JSON (stats, watchdog, flight tail)"
+            )
+        if self.alerts is not None:
+            lines.append(
+                "  /alertz   SLO + alert state JSON (burn rates, budgets)"
+            )
+        return "\n".join(lines) + "\n"
 
     @property
     def url(self) -> str:

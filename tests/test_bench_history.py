@@ -1,0 +1,166 @@
+"""``python -m mpi4dl_tpu.analyze bench-history`` (ISSUE satellite): the
+perf-trajectory comparator over committed bench round files — series
+extraction from result lines, regression verdicts with a tolerance band,
+CI exit codes, and the CLI dispatch through ``analysis.cli.main`` — plus
+a run over the repo's real BENCH_r*.json history (it must parse, whatever
+its verdict)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from mpi4dl_tpu.analysis.bench_history import (
+    compare,
+    extract_series,
+    main,
+    render_table,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round(n, rc, parsed):
+    return {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "",
+            "parsed": parsed}
+
+
+def _result(headline_value, extra_value, peak=None):
+    extras = {"resnet110_2048px_bs1": {"value": extra_value, "remat": "scan"}}
+    if peak is not None:
+        extras["resnet_peak_pixels"] = {
+            "peak_trainable_px_per_chip": peak, "img_per_sec_at_peak": 0.06,
+        }
+    return {
+        "metric": "amoebanetd_1024px_bs2_train_tpu",
+        "value": headline_value,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "extras": extras,
+    }
+
+
+def _write_rounds(tmp_path, rounds):
+    paths = []
+    for i, r in enumerate(rounds, start=1):
+        p = tmp_path / f"BENCH_r{i:02d}.json"
+        p.write_text(json.dumps(r))
+        paths.append(str(p))
+    return paths
+
+
+def test_extract_series_covers_headline_extras_and_peak():
+    s = extract_series(_result(7.0, 0.5, peak=4096))
+    assert s == {
+        "amoebanetd_1024px_bs2_train_tpu": 7.0,
+        "resnet110_2048px_bs1": 0.5,
+        "resnet_peak_pixels.peak_px": 4096.0,
+    }
+    # A failed round (parsed value None) contributes nothing.
+    assert extract_series({"metric": "m", "value": None}) == {}
+
+
+def test_trend_improvement_exits_zero(tmp_path, capsys):
+    paths = _write_rounds(tmp_path, [
+        _round(1, 1, None),                      # failed round: no data
+        _round(2, 0, _result(5.0, 0.50, peak=2048)),
+        _round(3, 0, _result(7.0, 0.51, peak=4096)),
+    ])
+    rc = main(paths + ["--json", str(tmp_path / "cmp.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "improved" in out and "flat" in out
+    assert "0 regression(s)" in out
+    cmp = json.loads((tmp_path / "cmp.json").read_text())
+    assert cmp["ok"] is True
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["amoebanetd_1024px_bs2_train_tpu"]["verdict"] == "improved"
+    assert by_key["amoebanetd_1024px_bs2_train_tpu"]["values"] == [
+        None, 5.0, 7.0,
+    ]
+    assert by_key["resnet110_2048px_bs1"]["verdict"] == "flat"  # +2% < 5%
+
+
+def test_regression_beyond_tolerance_exits_nonzero(tmp_path, capsys):
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, _result(7.0, 0.50)),
+        _round(2, 0, _result(6.0, 0.50)),        # -14% headline
+    ])
+    rc = main(paths)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "regressed" in out
+    assert "1 regression(s)" in out
+    # Inside a wider band the same delta passes.
+    assert main(paths + ["--tolerance", "0.2"]) == 0
+
+
+def test_regression_compares_against_last_round_that_measured(tmp_path):
+    """A round that skipped a key (budget, failure) must not reset the
+    baseline — the comparison reaches back to the last real measurement."""
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, _result(7.0, 0.50)),
+        _round(2, 1, None),                      # nothing measured
+        _round(3, 0, _result(6.0, 0.50)),        # vs r1, not vs nothing
+    ])
+    rounds = [json.load(open(p)) for p in paths]
+    cmp = compare(
+        [{"path": p, "n": r["n"], "rc": r["rc"], "result": r["parsed"]}
+         for p, r in zip(paths, rounds)],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    head = by_key["amoebanetd_1024px_bs2_train_tpu"]
+    assert head["previous"] == 7.0
+    assert head["verdict"] == "regressed"
+    assert cmp["ok"] is False
+    render_table(cmp)  # renders with a None-valued middle round
+
+
+def test_key_gone_is_reported_but_fails_only_in_strict(tmp_path):
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, _result(7.0, 0.50, peak=2048)),
+        _round(2, 0, _result(7.0, 0.50)),        # peak walk skipped
+    ])
+    assert main(list(paths)) == 0
+    assert main(list(paths) + ["--strict"]) == 1
+
+
+def test_latest_round_without_result_fails(tmp_path):
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, _result(7.0, 0.50)),
+        _round(2, 1, None),
+    ])
+    assert main(paths) == 1
+
+
+def test_cli_dispatch_through_analyze(tmp_path, capsys):
+    """ISSUE satellite (CLI smoke): the subcommand routes through the
+    ``python -m mpi4dl_tpu.analyze`` front door without touching the
+    lint path's jax setup."""
+    from mpi4dl_tpu.analysis.cli import main as cli_main
+
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, _result(5.0, 0.50)),
+        _round(2, 0, _result(7.0, 0.52)),
+    ])
+    rc = cli_main(["bench-history", *paths, "--tolerance", "0.1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "amoebanetd_1024px_bs2_train_tpu" in out
+
+
+def test_runs_on_the_committed_round_files(capsys):
+    """The real BENCH_r*.json history must parse and render end-to-end;
+    the verdict is whatever the trajectory says (this test pins the
+    reader, not the repo's perf)."""
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not files:
+        pytest.skip("no committed bench rounds in this checkout")
+    rc = main(files)
+    out = capsys.readouterr().out
+    assert rc in (0, 1)
+    assert "regression(s)" in out
+    # Round labels come from the files' own "n" fields.
+    assert "r01" in out or "#0" in out

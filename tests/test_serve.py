@@ -360,6 +360,8 @@ def test_serve_cli_end_to_end(capsys, tmp_path):
         "--image-size", "16", "--depth", "11", "--max-batch", "4",
         "--requests", "24", "--concurrency", "8", "--serial", "8",
         "--lint", "--metrics-port", "0", "--telemetry-dir", str(tmp_path),
+        "--slo-availability", "99.9", "--slo-latency-ms", "2500",
+        "--slo-interval", "0.2",
     ])
     assert rc == 0
     line = [
@@ -374,6 +376,12 @@ def test_serve_cli_end_to_end(capsys, tmp_path):
     # carry the registry-backed fields, and the JSONL span log landed.
     assert isinstance(rep["metrics_port"], int)
     assert rep["loadgen"]["engine"]["queue_depth"] == 0
+    # SLO verdict (ISSUE tentpole): 24/24 served inside a 1 s threshold
+    # leaves both budgets untouched and no alert fired.
+    assert rep["slo"]["ok"] is True
+    assert rep["slo"]["slos"]["availability"]["sli"] == 1.0
+    assert rep["slo"]["slos"]["availability"]["budget_remaining"] == 1.0
+    assert rep["slo"]["alerts_fired"] == {}
     (log,) = tmp_path.iterdir()
     served = [
         e for e in telemetry.read_events(str(log))

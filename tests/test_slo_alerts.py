@@ -1,0 +1,585 @@
+"""SLO engine (:mod:`mpi4dl_tpu.telemetry.windows` / ``.slo`` /
+``.alerts`` / ``.autoscale``): windowed rate/increase semantics on a fake
+clock, hand-computed golden burn-rate values, the alert state machine's
+pending/for-duration/resolve transitions, autoscaler hysteresis +
+cooldown, schema-valid transition events — and the ISSUE fault drill: a
+stalled batcher floods queue-full rejections, the fast-burn ``page``
+alert fires on ``/alertz`` while the watchdog flips ``/healthz``,
+``desired_replicas`` rises, and recovery resolves everything. CPU-only,
+tier-1.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.telemetry.alerts import AlertState, SLOEvaluator
+from mpi4dl_tpu.telemetry.autoscale import AutoscaleConfig, Autoscaler
+from mpi4dl_tpu.telemetry.slo import (
+    BurnWindow,
+    SLOConfig,
+    availability_objective,
+    budget_remaining,
+    burn_rate,
+    latency_objective,
+    resolve_bucket_bound,
+    sli,
+)
+from mpi4dl_tpu.telemetry.windows import SnapshotWindow
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- snapshot window ----------------------------------------------------------
+
+
+def _reg_with_counter():
+    reg = telemetry.MetricsRegistry()
+    return reg, telemetry.declare(reg, "serve_requests_total")
+
+
+def test_window_rate_and_increase_golden():
+    reg, c = _reg_with_counter()
+    clock = _Clock()
+    w = SnapshotWindow(reg, clock=clock)
+    c.inc(100, outcome="served")
+    w.record(0.0)
+    c.inc(60, outcome="served")
+    clock.t = 30.0
+    w.record(30.0)
+    assert w.increase("serve_requests_total", 30, outcome="served") == 60
+    assert w.rate("serve_requests_total", 30, outcome="served") == (
+        pytest.approx(2.0)
+    )
+    # A window longer than the history uses what exists (cold start).
+    assert w.increase("serve_requests_total", 9999, outcome="served") == 60
+    # One snapshot only -> no data.
+    w2 = SnapshotWindow(reg, clock=clock)
+    w2.record(0.0)
+    assert w2.increase("serve_requests_total", 30, outcome="served") is None
+    assert w2.rate("serve_requests_total", 30, outcome="served") is None
+
+
+def test_window_uses_at_least_the_requested_span():
+    """With snapshots at 0/10/20/30 a 15s window must pair the newest
+    with t=10 (latest at-or-before the cutoff), not t=20 — windows cover
+    at least the requested span once history allows."""
+    reg, c = _reg_with_counter()
+    w = SnapshotWindow(reg, clock=_Clock())
+    for t in (0.0, 10.0, 20.0, 30.0):
+        c.inc(10, outcome="served")
+        w.record(t)
+    # t=10 snapshot holds 20, newest holds 40.
+    assert w.increase("serve_requests_total", 15, outcome="served") == 20
+    assert w.rate("serve_requests_total", 15, outcome="served") == (
+        pytest.approx(1.0)  # 20 over the actual 20s elapsed
+    )
+
+
+def test_window_series_appearing_mid_window_baselines_at_zero():
+    """The first rejected_queue_full of a process's life must count as an
+    increase, not vanish because the old snapshot lacks the series."""
+    reg, c = _reg_with_counter()
+    w = SnapshotWindow(reg, clock=_Clock())
+    c.inc(5, outcome="served")
+    w.record(0.0)
+    c.inc(3, outcome="rejected_queue_full")
+    w.record(10.0)
+    assert w.increase(
+        "serve_requests_total", 60, outcome="rejected_queue_full"
+    ) == 3
+    incs = dict(
+        (labels["outcome"], d)
+        for labels, d in w.increases("serve_requests_total", 60)
+    )
+    assert incs == {"served": 0, "rejected_queue_full": 3}
+    # The windowed availability ratio: 0 good / 3 total.
+    assert w.availability(
+        "serve_requests_total", 60, good=("served",)
+    ) == 0.0
+
+
+def test_window_counter_restart_returns_none():
+    reg = telemetry.MetricsRegistry()
+    g = reg.gauge("serve_queue_depth")  # raw registry: simulate via gauge
+    c = reg.counter("ctr_total")
+    w = SnapshotWindow(reg, clock=_Clock())
+    c.inc(10)
+    g.set(4)
+    w.record(0.0)
+    c._series[()] = 2.0  # counter restarted (new process would)
+    g.set(8)
+    w.record(10.0)
+    assert w.increase("ctr_total", 60) is None
+    assert w.mean_gauge("serve_queue_depth", 60) == pytest.approx(6.0)
+
+
+def test_window_hist_increase_and_bucket_resolution():
+    reg = telemetry.MetricsRegistry()
+    h = telemetry.declare(reg, "serve_request_latency_seconds")
+    w = SnapshotWindow(reg, clock=_Clock())
+    w.record(0.0)
+    for v in (0.01, 0.03, 0.2):
+        h.observe(v)
+    w.record(10.0)
+    d = w.hist_increase("serve_request_latency_seconds", 60)
+    assert d["count"] == 3
+    assert d["buckets"]["0.05"] == 2  # cumulative: the two fast ones
+    assert w.bucket_ratio(
+        "serve_request_latency_seconds", 60, 0.05
+    ) == pytest.approx(2 / 3)
+    # Threshold between bounds resolves DOWN (conservative).
+    assert resolve_bucket_bound((0.01, 0.05, 0.1), 0.07) == 0.05
+    assert resolve_bucket_bound((0.01, 0.05, 0.1), 0.05) == 0.05
+    assert resolve_bucket_bound((0.01, 0.05), 0.001) is None
+
+
+# -- burn-rate golden values --------------------------------------------------
+
+
+def _evaluated_registry():
+    """Registry + window with one hand-computed traffic hour: 900 served
+    + 100 rejected, 1000 latency observations of which 950 <= 50 ms."""
+    reg = telemetry.MetricsRegistry()
+    req = telemetry.declare(reg, "serve_requests_total")
+    lat = telemetry.declare(reg, "serve_request_latency_seconds")
+    w = SnapshotWindow(reg, clock=_Clock())
+    w.record(0.0)
+    req.inc(900, outcome="served")
+    req.inc(100, outcome="rejected_queue_full")
+    for i in range(1000):
+        lat.observe(0.04 if i < 950 else 0.2)
+    w.record(60.0)
+    return reg, w
+
+
+def test_burn_rate_golden_values():
+    """Hand-computed: 10% errors at a 99.9% objective burn the budget at
+    0.1/0.001 = 100x; 5% slow at a 99% latency objective burn at
+    0.05/0.01 = 5x. Budget remaining: 1 - 100 = -99 (overspent)."""
+    reg, w = _evaluated_registry()
+    avail = availability_objective(0.999)
+    lat = latency_objective(0.99, threshold_s=0.05)
+    assert sli(w, avail, 60) == pytest.approx(0.9)
+    assert burn_rate(w, avail, 60) == pytest.approx(100.0)
+    assert sli(w, lat, 60) == pytest.approx(0.95)
+    assert burn_rate(w, lat, 60) == pytest.approx(5.0)
+    assert budget_remaining(reg, avail) == pytest.approx(-99.0)
+    assert budget_remaining(reg, lat) == pytest.approx(-4.0)
+
+
+def test_burn_rate_no_traffic_is_no_data():
+    reg = telemetry.MetricsRegistry()
+    telemetry.declare(reg, "serve_requests_total")
+    w = SnapshotWindow(reg, clock=_Clock())
+    w.record(0.0)
+    w.record(60.0)
+    avail = availability_objective(0.999)
+    assert sli(w, avail, 60) is None
+    assert burn_rate(w, avail, 60) is None
+    assert budget_remaining(reg, avail) is None
+
+
+def test_slo_config_validation():
+    with pytest.raises(ValueError, match="0.999, not 99.9"):
+        SLOConfig(availability=99.9).objectives()
+    with pytest.raises(ValueError, match="less history"):
+        SLOConfig(availability=0.999, window_capacity=10).objectives()
+    assert SLOConfig().objectives() == []  # no objectives -> nothing to run
+    assert len(SLOConfig(availability=0.99,
+                         latency_threshold_s=0.05).objectives()) == 2
+
+
+# -- alert state machine ------------------------------------------------------
+
+
+def test_alert_state_machine_for_duration():
+    a = AlertState("x", "page", for_s=2.0)
+    assert a.step(False, 0.0) is None and a.state == "inactive"
+    assert a.step(True, 1.0) == ("inactive", "pending")
+    assert a.step(True, 2.0) is None  # held 1s < for 2s
+    assert a.step(True, 3.5) == ("pending", "firing")
+    assert a.fired_count == 1
+    assert a.step(True, 4.0) is None  # stays firing, no re-fire
+    assert a.step(False, 5.0) == ("firing", "inactive")  # resolved
+    # pending that clears before for_s cancels without ever firing
+    assert a.step(True, 10.0) == ("inactive", "pending")
+    assert a.step(False, 11.0) == ("pending", "inactive")
+    assert a.fired_count == 1
+
+
+def test_alert_zero_for_fires_immediately():
+    a = AlertState("x", "page", for_s=0.0)
+    assert a.step(True, 1.0) == ("inactive", "firing")
+
+
+# -- evaluator: gauges, transitions, schema -----------------------------------
+
+
+def _drive_evaluator(for_s=0.0):
+    reg = telemetry.MetricsRegistry()
+    req = telemetry.declare(reg, "serve_requests_total")
+    telemetry.declare(reg, "serve_request_latency_seconds")
+    telemetry.declare(reg, "serve_queue_depth").set(0)
+    clock = _Clock()
+    cfg = SLOConfig(availability=0.999, for_s=for_s, interval_s=1.0)
+    flight = telemetry.FlightRecorder(capacity=64, registry=reg)
+    ev = SLOEvaluator(
+        reg, cfg.objectives(), cfg,
+        autoscaler=Autoscaler(
+            reg, AutoscaleConfig(up_cooldown_s=1.0, down_cooldown_s=5.0,
+                                 signal_window_s=30.0, max_replicas=3),
+            queue_capacity=64, clock=clock,
+        ),
+        flight=flight, clock=clock, start=False,
+    )
+    return reg, req, clock, ev, flight
+
+
+def test_evaluator_fires_resolves_and_publishes():
+    reg, req, clock, ev, flight = _drive_evaluator()
+    req.inc(10, outcome="served")
+    ev.evaluate_once(0.0)
+    # Clean traffic: burn 0, nothing fires, desired stays at min.
+    req.inc(10, outcome="served")
+    clock.t = 10.0
+    ev.evaluate_once(10.0)
+    assert ev.alerts["availability_fast_burn"].state == "inactive"
+    assert reg.get("slo_burn_rate").value(
+        slo="availability", window="fast_long"
+    ) == 0.0
+    assert reg.get("autoscale_desired_replicas").value() == 1
+
+    # 100% failures: burn 1000 >> 14.4 on both windows -> page fires,
+    # autoscaler sees rejections -> desired rises.
+    req.inc(20, outcome="rejected_queue_full")
+    clock.t = 20.0
+    ev.evaluate_once(20.0)
+    st = ev.alerts["availability_fast_burn"]
+    assert st.state == "firing" and st.severity == "page"
+    assert reg.get("alert_active").value(
+        alert="availability_fast_burn", severity="page"
+    ) == 1.0
+    assert reg.get("slo_error_budget_remaining").value(
+        slo="availability"
+    ) < 0
+    assert reg.get("autoscale_desired_replicas").value() == 2
+
+    # Recovery: enough clean traffic that BOTH windows drop below the
+    # factor (short clears first; the long window needs the errors to
+    # age past its span).
+    req.inc(5000, outcome="served")
+    for t in (90.0, 100.0):
+        clock.t = t
+        ev.evaluate_once(t)
+    assert ev.alerts["availability_fast_burn"].state == "inactive"
+    assert reg.get("alert_active").value(
+        alert="availability_fast_burn", severity="page"
+    ) == 0.0
+
+    # Transitions were recorded — schema-valid, in order, and into the
+    # flight ring for the postmortem story.
+    trans = [t for t in ev.transitions
+             if t["attrs"]["alert"] == "availability_fast_burn"]
+    assert [(t["attrs"]["from"], t["attrs"]["to"]) for t in trans] == [
+        ("inactive", "firing"), ("firing", "inactive"),
+    ]
+    for t in trans:
+        telemetry.validate_event(t)
+    ring_names = [e.get("name") for e in flight.tail(100)]
+    assert ring_names.count("alert.transition") >= 2
+    v = ev.verdict()
+    assert v["ok"] is False
+    assert v["alerts_fired"]["availability_fast_burn"] == 1
+
+
+def test_evaluator_for_duration_pending_then_firing():
+    reg, req, clock, ev, _ = _drive_evaluator(for_s=15.0)
+    req.inc(10, outcome="served")
+    ev.evaluate_once(0.0)
+    req.inc(50, outcome="rejected_queue_full")
+    clock.t = 10.0
+    ev.evaluate_once(10.0)
+    assert ev.alerts["availability_fast_burn"].state == "pending"
+    assert reg.get("alert_active").value(
+        alert="availability_fast_burn", severity="page"
+    ) == 0.0  # pending is not active
+    req.inc(50, outcome="rejected_queue_full")
+    clock.t = 30.0
+    ev.evaluate_once(30.0)
+    assert ev.alerts["availability_fast_burn"].state == "firing"
+
+
+# -- autoscaler ---------------------------------------------------------------
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    reg = telemetry.MetricsRegistry()
+    req = telemetry.declare(reg, "serve_requests_total")
+    qd = telemetry.declare(reg, "serve_queue_depth")
+    clock = _Clock()
+    w = SnapshotWindow(reg, clock=clock)
+    auto = Autoscaler(
+        reg,
+        AutoscaleConfig(min_replicas=1, max_replicas=3, queue_high=0.5,
+                        queue_low=0.1, signal_window_s=30.0,
+                        up_cooldown_s=10.0, down_cooldown_s=20.0),
+        queue_capacity=64, clock=clock,
+    )
+    qd.set(0)
+    w.record(0.0)
+    assert auto.update(0.0, w, None) == 1
+
+    # Deep queue -> pressure, but the up cooldown paces the steps.
+    qd.set(40)  # > 0.5 * 64
+    clock.t = 5.0
+    w.record(5.0)
+    assert auto.update(5.0, w, None) == 1  # 5s < up_cooldown since start
+    clock.t = 12.0
+    w.record(12.0)
+    assert auto.update(12.0, w, None) == 2
+    clock.t = 13.0
+    w.record(13.0)
+    assert auto.update(13.0, w, None) == 2  # cooldown again
+    clock.t = 25.0
+    w.record(25.0)
+    assert auto.update(25.0, w, None) == 3
+    clock.t = 40.0
+    w.record(40.0)
+    assert auto.update(40.0, w, None) == 3  # capped at max_replicas
+
+    # Mid-band depth (between low 6.4 and high 32 watermarks): neither
+    # pressure nor calm — the hysteresis dead zone holds the count.
+    qd.set(10)
+    for t in (75.0, 80.0, 85.0):
+        clock.t = t
+        w.record(t)
+        assert auto.update(t, w, None) == 3
+
+    # Calm (depth under the low watermark, no rejections, burn low) for
+    # down_cooldown -> steps back down.
+    qd.set(0)
+    desired = []
+    for t in (120.0, 130.0, 141.0, 150.0, 162.0):
+        clock.t = t
+        w.record(t)
+        desired.append(auto.update(t, w, 0.0))
+    assert desired[-1] < 3  # decayed
+    assert 1 in desired or desired[-1] >= 1
+
+    # Rejections in the window veto scale-down even at depth 0.
+    auto2 = Autoscaler(
+        reg, AutoscaleConfig(down_cooldown_s=0.0, up_cooldown_s=0.0,
+                             signal_window_s=30.0),
+        queue_capacity=64, clock=clock,
+    )
+    req.inc(3, outcome="rejected_queue_full")
+    clock.t = 200.0
+    w.record(200.0)
+    before = auto2.desired
+    auto2.update(200.0, w, None)
+    assert auto2.desired >= before  # pressure, not calm
+
+
+# -- the ISSUE fault drill ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+
+    size = 16
+    cells = get_resnet_v2(depth=11, num_classes=10, pool_kernel=size // 4)
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+    return cells, params, stats, size
+
+
+def _drill_slo_config():
+    """Windows scaled to test time: fast 2s/0.5s page, slow 6s/1.5s
+    ticket; evaluator ticks at 10 Hz so 'within one evaluation interval'
+    is sub-second."""
+    return SLOConfig(
+        availability=0.999,
+        latency_threshold_s=5.0,  # loose: the drill is about availability
+        burn_windows=(
+            BurnWindow("fast", "page", long_s=2.0, short_s=0.5, factor=14.4),
+            BurnWindow("slow", "ticket", long_s=6.0, short_s=1.5, factor=6.0),
+        ),
+        interval_s=0.1,
+        autoscale=AutoscaleConfig(
+            min_replicas=1, max_replicas=3, signal_window_s=1.0,
+            up_cooldown_s=0.2, down_cooldown_s=0.5,
+        ),
+    )
+
+
+def _get_json(url):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read())
+
+
+def test_slo_fault_drill(engine_parts, tmp_path):
+    """ISSUE acceptance: a stalled batcher + queue-full flood trips the
+    watchdog AND fires the availability fast-burn page alert on /alertz;
+    desired_replicas rises during the stall; recovery resolves the alert,
+    decays the replica count, and the flight dump carries the alert
+    transitions."""
+    from mpi4dl_tpu.serve import QueueFullError, ServingEngine
+
+    cells, params, stats, size = engine_parts
+    eng = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3), max_batch=2,
+        max_queue=4, default_deadline_s=30.0, metrics_port=0,
+        watchdog_factor=2.0, watchdog_min_timeout_s=0.25,
+        flight_dir=str(tmp_path), slo=_drill_slo_config(),
+    )
+    base = f"http://127.0.0.1:{eng.metrics_port}"
+    x = np.zeros((size, size, 3), np.float32)
+
+    # Index satellite: probing the root discovers the whole surface.
+    index = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+    for route in ("/metrics", "/healthz", "/debugz", "/alertz"):
+        assert route in index
+
+    alertz = _get_json(f"{base}/alertz")
+    assert {a["name"] for a in alertz["alerts"]} == {
+        "availability_fast_burn", "availability_slow_burn",
+        "latency_fast_burn", "latency_slow_burn",
+    }
+    assert all(a["state"] == "inactive" for a in alertz["alerts"])
+
+    # Stall the loop: every bucket executable sleeps well past the
+    # watchdog timeout before doing the real work.
+    orig = dict(eng._compiled)
+
+    def _slow(bucket):
+        def call(p, s, batch):
+            time.sleep(1.5)
+            return orig[bucket](p, s, batch)
+        return call
+
+    eng._compiled = {b: _slow(b) for b in eng.buckets}
+    eng.start()
+    try:
+        stalled = eng.submit(x, deadline_s=30.0)
+        rejections = 0
+        deadline = time.time() + 15
+        fired = saw_503 = False
+        max_desired = 1.0
+        while time.time() < deadline:
+            # Flood: the 4-deep queue fills while the loop sleeps; every
+            # further submit is a rejected_queue_full — the availability
+            # SLI craters while the stall is still in progress.
+            try:
+                eng.submit(x, deadline_s=30.0)
+            except QueueFullError:
+                rejections += 1
+            state = _get_json(f"{base}/alertz")
+            fast = next(
+                a for a in state["alerts"]
+                if a["name"] == "availability_fast_burn"
+            )
+            max_desired = max(
+                max_desired,
+                state["autoscale"]["desired_replicas"],
+            )
+            fired = fired or fast["state"] == "firing"
+            # The watchdog side of the drill: /healthz flips too (the
+            # stall is also a liveness event, not just an SLO event).
+            # Polled DURING the stall — health auto-recovers on the next
+            # completion, so a post-hoc poll could miss the 503 phase.
+            try:
+                status = urllib.request.urlopen(
+                    f"{base}/healthz", timeout=10
+                ).status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            saw_503 = saw_503 or status == 503
+            if fired and saw_503 and max_desired > 1:
+                break
+            time.sleep(0.02)
+        assert rejections > 0, "queue never filled — no availability signal"
+        assert fired, "fast-burn page alert never fired during the stall"
+        assert saw_503, "watchdog never flipped /healthz during the stall"
+        assert eng.registry.get("watchdog_trips_total").value() >= 1
+        assert max_desired > 1, "autoscale signal never rose"
+
+        # Recovery: stop flooding, let the stalled batches drain, serve
+        # clean traffic until the burn windows clear and the alert
+        # resolves.
+        assert stalled.result(timeout=30).shape == (10,)
+        deadline = time.time() + 30
+        resolved = False
+        while time.time() < deadline:
+            try:
+                eng.submit(x, deadline_s=30.0).result(timeout=30)
+            except QueueFullError:
+                time.sleep(0.1)
+                continue
+            state = _get_json(f"{base}/alertz")
+            fast = next(
+                a for a in state["alerts"]
+                if a["name"] == "availability_fast_burn"
+            )
+            if fast["state"] == "inactive":
+                resolved = True
+                break
+        assert resolved, "page alert never resolved after recovery"
+
+        # ... and the advisory replica count decays once calm holds past
+        # the down cooldown.
+        deadline = time.time() + 30
+        decayed = False
+        while time.time() < deadline:
+            try:
+                eng.submit(x, deadline_s=30.0).result(timeout=30)
+            except QueueFullError:
+                time.sleep(0.05)
+                continue
+            if eng.registry.get(
+                "autoscale_desired_replicas"
+            ).value() == 1:
+                decayed = True
+                break
+        assert decayed, "desired_replicas never decayed after recovery"
+    finally:
+        eng._compiled = orig
+        eng.stop()
+
+    # The postmortem story: a flight dump after the incident carries the
+    # alert transitions next to the request spans.
+    path = eng.dump_flight(reason="manual")
+    events = telemetry.read_events(path)  # schema-validates every line
+    trans = [e for e in events if e.get("name") == "alert.transition"]
+    pairs = [
+        (t["attrs"]["from"], t["attrs"]["to"]) for t in trans
+        if t["attrs"]["alert"] == "availability_fast_burn"
+    ]
+    assert ("inactive", "firing") in pairs
+    assert ("firing", "inactive") in pairs
+
+    # /debugz carries the SLO state for one-stop diagnostics.
+    v = eng.slo.verdict()
+    assert v["alerts_fired"]["availability_fast_burn"] >= 1
+    assert v["ok"] is False  # a page fired during this process's life
