@@ -409,6 +409,19 @@ def _measure_serving() -> dict:
         "lint_ok": lint.ok,
         "slo": engine.slo.verdict(),
     }
+    # Phase mix + client-hop cost (docs/OBSERVABILITY.md "Federation &
+    # distributed tracing"): the per-round trajectory of WHERE served
+    # latency goes, next to the throughput it costs.
+    if rep.get("client_overhead_s"):
+        entry["client_overhead_ms"] = {
+            k: round(v * 1e3, 3) for k, v in rep["client_overhead_s"].items()
+        }
+    shares = engine.registry.get("serve_phase_share")
+    if shares is not None:
+        entry["phase_shares"] = {
+            s["labels"]["phase"]: round(s["value"], 4)
+            for s in shares.snapshot_series()
+        }
     if attribution is not None:
         entry["attribution"] = attribution
     if not lint.ok:

@@ -14,9 +14,14 @@ Examples::
     python -m mpi4dl_tpu.analyze --model amoebanet --size 64 --dp 2
     python -m mpi4dl_tpu.analyze --model resnet --size 512 --write-baseline
 
-One subcommand: ``python -m mpi4dl_tpu.analyze bench-history
+Two subcommands: ``python -m mpi4dl_tpu.analyze bench-history
 BENCH_r*.json`` compares the committed bench rounds and fails on a
-throughput regression (:mod:`mpi4dl_tpu.analysis.bench_history`).
+throughput regression (:mod:`mpi4dl_tpu.analysis.bench_history`);
+``python -m mpi4dl_tpu.analyze trace-export LOG... [--trace-id ID]``
+joins span segments from N processes' JSONL telemetry logs by trace id
+and writes one Chrome trace — a request's full client → queue → batch →
+device lifetime across process boundaries
+(:func:`mpi4dl_tpu.telemetry.federation.trace_export_main`).
 """
 
 from __future__ import annotations
@@ -143,6 +148,11 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.bench_history import main as bench_history
 
         return bench_history(argv[1:])
+    if argv and argv[0] == "trace-export":
+        # Also pure JSON: joins JSONL span logs into a Chrome trace.
+        from mpi4dl_tpu.telemetry.federation import trace_export_main
+
+        return trace_export_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from mpi4dl_tpu.utils import apply_platform_env, enable_compilation_cache

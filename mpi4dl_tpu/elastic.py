@@ -37,6 +37,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import threading
 import time
 
 HEARTBEAT_ENV = "MPI4DL_TPU_HEARTBEAT"
@@ -57,6 +58,85 @@ def touch(path: str) -> None:
 def heartbeat_path_from_env() -> str | None:
     """The heartbeat file this (child) process should touch, if supervised."""
     return os.environ.get(HEARTBEAT_ENV)
+
+
+class HeartbeatReporter:
+    """Health-gated heartbeat: beats stop the moment the process stops
+    being useful, so the supervisor's staleness detector fires.
+
+    The training loop beats inline (:func:`touch` per step — a stalled
+    loop stops beating by construction). A SERVING replica has no such
+    luck: its submit path and HTTP threads keep running while the batcher
+    is wedged, so a naive timer thread would keep the heartbeat fresh
+    forever and :func:`supervise` would never fire — the exact
+    wedged-but-alive shape the reference suffers from (SURVEY §5.3).
+    This reporter closes the loop with the liveness machinery from
+    :mod:`mpi4dl_tpu.telemetry.health`: a daemon thread touches ``path``
+    every ``interval_s`` ONLY while the :class:`HealthState` says healthy
+    and the :class:`Watchdog` (if given) is not tripped. A watchdog trip
+    (batcher stalled) or a crash-flipped health state silences the
+    heartbeat; after ``hang_timeout`` of silence the supervisor kills and
+    restarts the replica. Health recovering (work completing again)
+    resumes the beats — a transient stall that self-heals before the
+    timeout costs nothing.
+
+    health: a :class:`mpi4dl_tpu.telemetry.HealthState`
+        (``engine.health``); None = always considered healthy.
+    watchdog: a :class:`mpi4dl_tpu.telemetry.Watchdog`; its tripped
+        state gates beats even when no health object is wired.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        health=None,
+        watchdog=None,
+        interval_s: float = 0.5,
+    ):
+        self.path = path
+        self.health = health
+        self.watchdog = watchdog
+        self.interval_s = float(interval_s)
+        self._stop_evt = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def healthy(self) -> bool:
+        if self.health is not None and not self.health.healthy:
+            return False
+        if self.watchdog is not None and self.watchdog.state()["tripped"]:
+            return False
+        return True
+
+    def beat_once(self) -> bool:
+        """Touch the heartbeat iff the process is healthy; returns
+        whether it beat."""
+        if not self.healthy():
+            return False
+        touch(self.path)
+        return True
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+        self.beat_once()  # cover the gap before the first interval
+        self._thread = threading.Thread(
+            target=self._run, name="mpi4dl-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.beat_once()
+            except OSError:
+                pass  # a transient fs error must not kill the reporter
+
+    def close(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
 
 def supervise(

@@ -99,6 +99,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "attribute device time per serve batch "
                         "(report key 'attribution', /debugz, trace_* "
                         "gauges)")
+    p.add_argument("--attribution-every", type=int, default=0,
+                   help="sampled continuous attribution: every N "
+                        "dispatches, capture+attribute one batch and "
+                        "publish live trace_* gauges under "
+                        "program=serve_sampled (0 disables; mutually "
+                        "exclusive with --trace-dir, whose profile owns "
+                        "the profiler)")
+    p.add_argument("--attribution-min-interval", type=float, default=30.0,
+                   help="floor between attribution samples, seconds — "
+                        "caps the amortized sampling overhead at "
+                        "~capture cost / interval regardless of rps")
     p.add_argument("--json", dest="json_out", default=None,
                    help="also write the report JSON here")
     return p
@@ -143,6 +154,8 @@ def _liveness_kw(args) -> dict:
         "flight_capacity": args.flight_capacity,
         "flight_dir": args.flight_dir,
         "slo": _slo_config(args),
+        "attribution_every": args.attribution_every,
+        "attribution_min_interval_s": args.attribution_min_interval,
     }
 
 
@@ -197,6 +210,19 @@ def main(argv=None) -> int:
     # disposition terminates the process.
     engine.flight.install_signal_handlers()
 
+    # Supervised replica (elastic.supervise / the fleet babysitter):
+    # health-gated heartbeat — a wedged batcher trips the watchdog, the
+    # beats stop, the supervisor kills and restarts this process.
+    from mpi4dl_tpu import elastic
+
+    heartbeat = None
+    hb_path = elastic.heartbeat_path_from_env()
+    if hb_path:
+        heartbeat = elastic.HeartbeatReporter(
+            hb_path, health=engine.health, watchdog=engine.watchdog,
+        )
+        heartbeat.start()
+
     report = {
         "model": "checkpoint:" + args.ckpt if args.ckpt else
                  f"synthetic_resnet{args.depth}_{args.image_size}px",
@@ -229,14 +255,18 @@ def main(argv=None) -> int:
                 report["loadgen"] = run_closed_loop(
                     engine, args.requests, concurrency=args.concurrency,
                     deadline_s=args.deadline_ms / 1e3,
+                    events=engine.events,
                 )
             else:
                 report["loadgen"] = run_open_loop(
                     engine, rate_rps=args.rate, duration_s=args.duration,
                     deadline_s=args.deadline_ms / 1e3,
+                    events=engine.events,
                 )
     finally:
         engine.stop()
+        if heartbeat is not None:
+            heartbeat.close()
 
     if args.trace_dir:
         try:
@@ -261,6 +291,10 @@ def main(argv=None) -> int:
             report["attribution"] = {
                 "error": f"{type(e).__name__}: {str(e)[:160]}"
             }
+
+    if args.attribution_every and engine.last_attribution is not None:
+        # The most recent sampled capture (the live gauges' source).
+        report["attribution_sampled"] = engine.last_attribution
 
     if engine.slo is not None:
         report["slo"] = engine.slo.verdict()

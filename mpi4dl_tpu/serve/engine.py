@@ -56,8 +56,10 @@ latest trace-attribution summary live.
 from __future__ import annotations
 
 import dataclasses
-import itertools
+import os
 import queue
+import shutil
+import tempfile
 import threading
 import time
 from concurrent.futures import Future
@@ -126,9 +128,27 @@ class ServingEngine:
         daemon :class:`telemetry.SLOEvaluator` snapshots the registry
         every ``interval_s``, computes multi-window burn rates, runs the
         ``pending → firing → resolved`` alert machines (transitions land
-        in the JSONL log and the flight ring), drives the advisory
-        autoscaler, and serves it all on ``/alertz`` (:attr:`slo`).
-        None (default) runs no evaluator.
+        in the JSONL log and the flight ring; latency transitions carry
+        a phase-attribution payload naming the span phase whose share
+        grew), drives the advisory autoscaler, and serves it all on
+        ``/alertz`` (:attr:`slo`). None (default) runs no evaluator.
+    attribution_every: sampled continuous attribution — every N
+        dispatches, run ONE batch synchronously under a private XProf
+        capture and publish the parsed device-time attribution as the
+        live ``trace_*`` gauges (``program="serve_sampled"``), turning
+        the one-shot ``--trace-dir`` report into a continuously
+        refreshed signal (T3's track-and-trigger, applied to serving).
+        The sampled batch loses its double-buffering overlap; everything
+        between samples runs the normal async path. None/0 disables.
+    attribution_min_interval_s: floor between samples. A capture costs
+        ~100 ms of profiler start/stop + parse regardless of batch size
+        (measured on this container's CPU backend), so the dispatch
+        cadence alone would let a high-rps workload burn arbitrary time
+        in sampling; the time floor caps the amortized overhead at
+        roughly ``capture_cost / interval`` (~0.3% at the 30 s default)
+        no matter the request rate. The profiler backend's one-time
+        ~3 s init is paid at construction (with a throwaway capture),
+        never by a live request.
     """
 
     def __init__(
@@ -151,6 +171,8 @@ class ServingEngine:
         flight_capacity: int = 512,
         flight_dir: "str | None" = None,
         slo=None,
+        attribution_every: "int | None" = None,
+        attribution_min_interval_s: float = 30.0,
     ):
         import jax
         import jax.numpy as jnp
@@ -222,7 +244,12 @@ class ServingEngine:
         self._m_pad_waste = decl("serve_pad_waste_ratio")
         self._m_latency = decl("serve_request_latency_seconds")
         self._m_spans = decl("serve_span_seconds")
+        self._m_phase_share = decl("serve_phase_share")
+        self._phase_totals: dict[str, float] = {}
         self._m_qdepth.set(0)
+        self._attr_every = int(attribution_every or 0)
+        self._attr_min_interval_s = float(attribution_min_interval_s)
+        self._attr_last_t = float("-inf")
         warm = decl("serve_warm_latency_seconds")
         for b, t in self.warm_latency_s.items():
             warm.set(t, bucket=b)
@@ -247,6 +274,18 @@ class ServingEngine:
             # Prime the rolling-p99 history so the adaptive timeout is
             # meaningful before the first served request.
             self.watchdog.seed(max(self.warm_latency_s.values()))
+
+        if self._attr_every > 0:
+            # Pay the profiler backend's one-time init (~3 s measured)
+            # here, on a throwaway smallest-bucket capture, so the FIRST
+            # live sample costs the same ~100 ms as every later one
+            # instead of stalling a real batch past its deadline.
+            b = min(self._buckets)
+            self._dispatch_sampled(
+                np.zeros((b, *self.example_shape), self._np_dtype), b, -1,
+                publish=False,
+            )
+            self._attr_last_t = time.monotonic()
 
         # -- SLO evaluation (telemetry/slo.py, alerts.py, autoscale.py) -----
         self.slo: "telemetry.SLOEvaluator | None" = None
@@ -277,7 +316,6 @@ class ServingEngine:
             else None
         )
         self.metrics_port = self._server.port if self._server else None
-        self._req_seq = itertools.count()
 
     # -- construction helpers ------------------------------------------------
 
@@ -308,6 +346,13 @@ class ServingEngine:
     @property
     def buckets(self) -> tuple[int, ...]:
         return self._buckets
+
+    @property
+    def events(self) -> "telemetry.JsonlWriter":
+        """The engine's JSONL event writer — co-located publishers (the
+        in-process load generator's client-side span segments) write
+        through THIS handle rather than opening the same file twice."""
+        return self._events
 
     def assert_warm(self) -> None:
         """Every configured bucket must have its pre-built executable —
@@ -360,11 +405,25 @@ class ServingEngine:
             self._server = None
         self._events.close()
 
-    def submit(self, x, deadline_s: float | None = None) -> Future:
+    def submit(
+        self,
+        x,
+        deadline_s: float | None = None,
+        trace_id: "str | None" = None,
+    ) -> Future:
         """Enqueue one example; returns a ``Future`` resolving to its
         logits. Raises :class:`QueueFullError` when admission control
         rejects; the future raises :class:`DeadlineExceededError` when the
-        deadline passes before delivery."""
+        deadline passes before delivery.
+
+        trace_id: distributed-trace propagation — a caller in ANOTHER
+        process (load generator, fleet router) passes the id it minted so
+        this engine's span segment joins the caller's under one trace
+        (``telemetry.group_spans_by_trace`` / ``analyze trace-export``).
+        None mints a fresh globally-unique id. On delivery the future
+        additionally carries ``trace_id`` and ``e2e_latency_s``
+        attributes, so the caller can compute its own hop overhead
+        (``serve_client_overhead_seconds``)."""
         x = np.asarray(x, self._np_dtype)
         if x.shape != self.example_shape:
             raise ValueError(
@@ -378,7 +437,9 @@ class ServingEngine:
         )
         req = _Request(
             x=x, submit_t=now, deadline=ddl, future=Future(),
-            trace_id=telemetry.new_trace_id(f"serve-{next(self._req_seq)}"),
+            trace_id=(
+                str(trace_id) if trace_id else telemetry.new_trace_id("serve")
+            ),
         )
         with self._lock:
             self._counts["submitted"] += 1
@@ -460,9 +521,25 @@ class ServingEngine:
             "health": self.health.snapshot(),
             "watchdog": self.watchdog.state() if self.watchdog else None,
             "slo": self.slo.state() if self.slo is not None else None,
+            "phase_attribution": (
+                self.slo.last_phase_attribution
+                if self.slo is not None else None
+            ),
             "flight_tail": self.flight.tail(50),
             "attribution": self.last_attribution,
         }
+
+    def _publish_phase_shares(self) -> None:
+        """Refresh ``serve_phase_share{phase=}`` from the cumulative
+        served-latency phase mix (once per completed batch, four gauge
+        sets)."""
+        with self._lock:
+            totals = dict(self._phase_totals)
+        total = sum(totals.values())
+        if total <= 0:
+            return
+        for phase, v in totals.items():
+            self._m_phase_share.set(v / total, phase=phase)
 
     def dump_flight(self, path: "str | None" = None, reason: str = "manual"):
         """Dump the flight-recorder ring now; returns the JSONL path."""
@@ -574,9 +651,21 @@ class ServingEngine:
         batch = pad_batch([r.x for r in reqs], bucket, self._np_dtype)
         seq = self._batch_seq
         self._batch_seq += 1
-        with annotate_step("mpi4dl_serve_batch", seq):
-            staged = jax.device_put(batch, self._device)  # async H2D
-            out = self._compiled[bucket](self._params, self._stats, staged)
+        out = None
+        if (
+            self._attr_every > 0
+            and seq > 0
+            and seq % self._attr_every == 0
+            and time.monotonic() - self._attr_last_t
+            >= self._attr_min_interval_s
+        ):
+            out = self._dispatch_sampled(batch, bucket, seq)
+        if out is None:
+            with annotate_step("mpi4dl_serve_batch", seq):
+                staged = jax.device_put(batch, self._device)  # async H2D
+                out = self._compiled[bucket](
+                    self._params, self._stats, staged
+                )
         staged_t = time.monotonic()
         for r in reqs:
             r.staged_t = staged_t
@@ -592,6 +681,69 @@ class ServingEngine:
         self._m_pad_waste.set(waste)
         return out
 
+    def _dispatch_sampled(self, batch, bucket: int, seq: int,
+                          publish: bool = True):
+        """Sampled continuous attribution: run this one batch blocked
+        inside a private XProf capture, parse it, publish the live
+        ``trace_*`` gauges (``program="serve_sampled"``) and refresh
+        :attr:`last_attribution`. Returns the logits, or None to send
+        the batch down the normal async path instead (capture refused —
+        e.g. an outer ``--trace-dir`` profile already owns the
+        profiler; only one trace can be active per process).
+        ``publish=False`` is the constructor's profiler-warm-up mode."""
+        import jax
+
+        from mpi4dl_tpu.profiling import trace as profiler_trace
+
+        self._attr_last_t = time.monotonic()
+        tmp = tempfile.mkdtemp(prefix="mpi4dl-serve-sample-")
+        out = None
+        try:
+            try:
+                with profiler_trace(tmp):
+                    with annotate_step("mpi4dl_serve_batch", seq):
+                        staged = jax.device_put(batch, self._device)
+                        out = self._compiled[bucket](
+                            self._params, self._stats, staged
+                        )
+                        jax.block_until_ready(out)
+            except Exception as e:  # noqa: BLE001 — sampling must never
+                # fail a live batch; the normal dispatch path takes over
+                self._record_marker(
+                    "serve.sample_skipped", error=repr(e), batch_seq=seq
+                )
+                return out  # None unless the forward itself completed
+            if not publish:
+                return out
+            try:
+                from mpi4dl_tpu.analysis.trace import (
+                    analyze_trace_dir,
+                    publish_attribution,
+                )
+
+                summary = analyze_trace_dir(
+                    tmp, step_name="mpi4dl_serve_batch"
+                )
+                publish_attribution(
+                    summary, self.registry, program="serve_sampled"
+                )
+                self.last_attribution = {
+                    "program": "serve_sampled",
+                    "batch_seq": seq,
+                    "n_steps": summary["n_steps"],
+                    "per_step_mean": summary["per_step_mean"],
+                    "range": summary["range"],
+                    "collective": summary["collective"],
+                }
+            except Exception as e:  # noqa: BLE001 — a broken trace drops
+                # the sample, never the batch
+                self._record_marker(
+                    "serve.sample_error", error=repr(e), batch_seq=seq
+                )
+            return out
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
     def _complete(self, reqs: "list[_Request]", out) -> None:
         logits = np.asarray(out)  # blocks until the device batch finishes
         now = time.monotonic()
@@ -602,6 +754,11 @@ class ServingEngine:
         for i, r in enumerate(reqs):
             if self.watchdog is not None:
                 self.watchdog.done(now - r.submit_t)
+            # Cross-process trace surface: the caller (loadgen today, the
+            # fleet router tomorrow) reads these off the future to compute
+            # its hop overhead and to join its own span segment.
+            r.future.trace_id = r.trace_id
+            r.future.e2e_latency_s = now - r.submit_t
             if now > r.deadline:
                 with self._lock:
                     self._counts["served_late"] += 1
@@ -619,6 +776,7 @@ class ServingEngine:
             self._m_latency.observe(now - r.submit_t)
             self._emit_spans(r, now, "served", bucket, len(reqs))
             r.future.set_result(logits[i])
+        self._publish_phase_shares()
 
     def _emit_spans(
         self, r: _Request, end_t: float, outcome: str,
@@ -637,12 +795,22 @@ class ServingEngine:
             ("device_compute", end_t),
         ])
         telemetry.record_spans(self._m_spans, spans)
+        if outcome.startswith("served"):
+            # Served-latency phase mix for the serve_phase_share gauges
+            # (and the latency alerts' attribution baseline).
+            with self._lock:
+                for s in spans:
+                    self._phase_totals[s["phase"]] = (
+                        self._phase_totals.get(s["phase"], 0.0)
+                        + s["duration_s"]
+                    )
         if self.flight.enabled or self._events.enabled:
             ev = telemetry.span_event(
                 "serve.request", r.trace_id, spans,
                 attrs={"outcome": outcome, "bucket": bucket,
                        "batch_size": batch_size,
-                       "e2e_latency_s": end_t - r.submit_t},
+                       "e2e_latency_s": end_t - r.submit_t,
+                       "pid": os.getpid(), "role": "engine"},
             )
             self.flight.record(ev)
             if self._events.enabled:
@@ -662,7 +830,8 @@ class ServingEngine:
             ])
             ev = telemetry.span_event(
                 "serve.request", req.trace_id, spans,
-                attrs={"outcome": "rejected_deadline"},
+                attrs={"outcome": "rejected_deadline",
+                       "pid": os.getpid(), "role": "engine"},
             )
             self.flight.record(ev)
             if self._events.enabled:

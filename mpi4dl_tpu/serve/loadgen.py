@@ -20,11 +20,26 @@ Client-observed outcomes and latency also land in a telemetry registry
 (``loadgen_*`` metrics, docs/OBSERVABILITY.md) — by default the engine's
 own :attr:`ServingEngine.registry`, so one Prometheus scrape of
 ``--metrics-port`` shows the server-side spans AND the client-side view
-they must reconcile with.
+they must reconcile with. The gap between the two views is now measured
+per request, not eyeballed across percentile tables: the engine reports
+its own e2e latency on the resolved future, and the client publishes
+``client latency − engine e2e`` into ``serve_client_overhead_seconds`` —
+the hop cost a fleet router adds, attributable per replica once
+federated.
+
+Distributed tracing: the client mints each request's ``trace_id``
+(:func:`mpi4dl_tpu.telemetry.new_trace_id`) and hands it to
+``engine.submit(trace_id=...)`` — the propagation seam a cross-process
+router will use unchanged. With ``events=`` (a
+:class:`telemetry.JsonlWriter`, e.g. ``engine.events``), the client also
+emits its own ``client.request`` span segment per resolved request, so
+``python -m mpi4dl_tpu.analyze trace-export`` renders the full client →
+queue → batch → device lifetime under one id.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -73,14 +88,16 @@ def serial_throughput(
 
 
 class _Tally:
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, events=None):
         self.lock = threading.Lock()
         self.latencies: list[float] = []
+        self.overheads: list[float] = []
         self.served = 0
         self.rejected_queue_full = 0
         self.deadline_misses = 0
         self.errors = 0
-        self._m_requests = self._m_latency = None
+        self._events = events
+        self._m_requests = self._m_latency = self._m_overhead = None
         if registry is not None:
             from mpi4dl_tpu import telemetry
 
@@ -89,6 +106,9 @@ class _Tally:
             )
             self._m_latency = telemetry.declare(
                 registry, "loadgen_request_latency_seconds"
+            )
+            self._m_overhead = telemetry.declare(
+                registry, "serve_client_overhead_seconds"
             )
 
     def _count(self, outcome: str) -> None:
@@ -100,26 +120,71 @@ class _Tally:
             self.rejected_queue_full += 1
         self._count("rejected_queue_full")
 
-    def resolve(self, future, t_submit: float) -> None:
+    def resolve(
+        self,
+        future,
+        t_submit: float,
+        trace_id: "str | None" = None,
+        t_submitted: "float | None" = None,
+    ) -> None:
+        outcome = "served"
         try:
             future.result()
         except DeadlineExceededError:
+            outcome = "deadline_miss"
             with self.lock:
                 self.deadline_misses += 1
-            self._count("deadline_miss")
-            return
         except Exception:  # noqa: BLE001 — tallied, surfaced in the report
+            outcome = "error"
             with self.lock:
                 self.errors += 1
-            self._count("error")
+        t_done = time.monotonic()
+        self._count(outcome)
+        engine_e2e = getattr(future, "e2e_latency_s", None)
+        overhead = None
+        if outcome == "served":
+            lat = t_done - t_submit
+            with self.lock:
+                self.served += 1
+                self.latencies.append(lat)
+            if self._m_latency is not None:
+                self._m_latency.observe(lat)
+            if engine_e2e is not None:
+                # The client/router-hop cost: what THIS side added on top
+                # of the engine's own submit→result latency.
+                overhead = max(0.0, lat - engine_e2e)
+                with self.lock:
+                    self.overheads.append(overhead)
+                if self._m_overhead is not None:
+                    self._m_overhead.observe(overhead)
+        self._client_span(
+            trace_id, outcome, t_submit, t_submitted, t_done,
+            engine_e2e, overhead,
+        )
+
+    def _client_span(
+        self, trace_id, outcome, t_submit, t_submitted, t_done,
+        engine_e2e, overhead,
+    ) -> None:
+        """The client-side span segment of a distributed trace — joins
+        the engine's segment under the shared trace_id at export."""
+        if self._events is None or not self._events.enabled or not trace_id:
             return
-        lat = time.monotonic() - t_submit
-        with self.lock:
-            self.served += 1
-            self.latencies.append(lat)
-        self._count("served")
-        if self._m_latency is not None:
-            self._m_latency.observe(lat)
+        from mpi4dl_tpu import telemetry
+
+        attrs = {"outcome": outcome, "pid": os.getpid(), "role": "client"}
+        if engine_e2e is not None:
+            attrs["engine_e2e_s"] = engine_e2e
+        if overhead is not None:
+            attrs["client_overhead_s"] = overhead
+        marks = [("issue", t_submit)]
+        if t_submitted is not None:
+            marks.append(("client_submit", t_submitted))
+        marks.append(("client_wait", t_done))
+        self._events.write(telemetry.span_event(
+            "client.request", trace_id,
+            telemetry.spans_from_marks(marks), attrs=attrs,
+        ))
 
 
 def run_closed_loop(
@@ -129,15 +194,21 @@ def run_closed_loop(
     deadline_s: float = 10.0,
     make_example=None,
     registry=None,
+    events=None,
 ) -> dict:
     """``concurrency`` clients ping-ponging until ``num_requests`` total
     have been submitted. High concurrency >> max batch keeps the queue
     deep enough that the engine forms full buckets — the regime where
     dynamic batching must beat serial bs-1 throughput. ``registry``
     defaults to the engine's own, so client-side metrics share its scrape
-    endpoint."""
+    endpoint; ``events`` (a JsonlWriter, e.g. ``engine.events``) adds a
+    ``client.request`` span segment per request to the trace log."""
+    from mpi4dl_tpu import telemetry
+
     make_example = make_example or _default_example(engine)
-    tally = _Tally(registry if registry is not None else engine.registry)
+    tally = _Tally(
+        registry if registry is not None else engine.registry, events=events,
+    )
     ticket = iter(range(num_requests))
     ticket_lock = threading.Lock()
 
@@ -147,13 +218,16 @@ def run_closed_loop(
                 i = next(ticket, None)
             if i is None:
                 return
+            tid = telemetry.new_trace_id("client")
             t = time.monotonic()
             try:
-                fut = engine.submit(make_example(i), deadline_s=deadline_s)
+                fut = engine.submit(
+                    make_example(i), deadline_s=deadline_s, trace_id=tid
+                )
             except QueueFullError:
                 tally.reject()
                 continue
-            tally.resolve(fut, t)
+            tally.resolve(fut, t, trace_id=tid, t_submitted=time.monotonic())
 
     threads = [threading.Thread(target=client) for _ in range(concurrency)]
     t0 = time.perf_counter()
@@ -173,11 +247,16 @@ def run_open_loop(
     deadline_s: float = 10.0,
     make_example=None,
     registry=None,
+    events=None,
 ) -> dict:
     """Fixed-rate arrivals for ``duration_s`` seconds; completions are
     collected by worker threads so a slow tail never throttles arrivals."""
+    from mpi4dl_tpu import telemetry
+
     make_example = make_example or _default_example(engine)
-    tally = _Tally(registry if registry is not None else engine.registry)
+    tally = _Tally(
+        registry if registry is not None else engine.registry, events=events,
+    )
     waiters: list[threading.Thread] = []
     period = 1.0 / rate_rps
     n = 0
@@ -188,14 +267,20 @@ def run_open_loop(
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
+        tid = telemetry.new_trace_id("client")
         t = time.monotonic()
         n += 1
         try:
-            fut = engine.submit(make_example(n), deadline_s=deadline_s)
+            fut = engine.submit(
+                make_example(n), deadline_s=deadline_s, trace_id=tid
+            )
         except QueueFullError:
             tally.reject()
             continue
-        w = threading.Thread(target=tally.resolve, args=(fut, t))
+        w = threading.Thread(
+            target=tally.resolve, args=(fut, t),
+            kwargs={"trace_id": tid, "t_submitted": time.monotonic()},
+        )
         w.start()
         waiters.append(w)
     for w in waiters:
@@ -207,6 +292,7 @@ def run_open_loop(
 
 def _report(mode, offered, dt, tally: _Tally, engine, **extra) -> dict:
     lat = tally.latencies
+    ov = tally.overheads
     return {
         "mode": mode,
         "offered": offered,
@@ -220,6 +306,11 @@ def _report(mode, offered, dt, tally: _Tally, engine, **extra) -> dict:
             **percentiles(lat),
             "mean": float(np.mean(lat)) if lat else None,
         },
+        # Client latency minus engine e2e, per request — the measured
+        # client/router-hop gap (PR 3 could only juxtapose the two p50s).
+        "client_overhead_s": (
+            {**percentiles(ov), "mean": float(np.mean(ov))} if ov else None
+        ),
         "engine": engine.stats(),
         **extra,
     }

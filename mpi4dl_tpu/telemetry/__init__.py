@@ -48,6 +48,7 @@ import threading
 from mpi4dl_tpu.telemetry.alerts import (  # noqa: F401
     AlertState,
     SLOEvaluator,
+    phase_attribution,
 )
 from mpi4dl_tpu.telemetry.autoscale import (  # noqa: F401
     AutoscaleConfig,
@@ -61,6 +62,14 @@ from mpi4dl_tpu.telemetry.catalog import (  # noqa: F401
 from mpi4dl_tpu.telemetry.export import (  # noqa: F401
     MetricsServer,
     render_prometheus,
+    unescape_help,
+    unescape_label_value,
+)
+from mpi4dl_tpu.telemetry.federation import (  # noqa: F401
+    FederatedAggregator,
+    FederatedRegistry,
+    ReplicaTarget,
+    merge_snapshots,
 )
 from mpi4dl_tpu.telemetry.flight import FlightRecorder  # noqa: F401
 from mpi4dl_tpu.telemetry.health import (  # noqa: F401
@@ -90,6 +99,8 @@ from mpi4dl_tpu.telemetry.slo import (  # noqa: F401
 )
 from mpi4dl_tpu.telemetry.windows import SnapshotWindow  # noqa: F401
 from mpi4dl_tpu.telemetry.spans import (  # noqa: F401
+    chrome_trace,
+    group_spans_by_trace,
     new_trace_id,
     record_spans,
     span_event,

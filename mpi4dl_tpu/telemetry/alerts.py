@@ -38,6 +38,48 @@ from mpi4dl_tpu.telemetry.windows import SnapshotWindow
 
 STATES = ("inactive", "pending", "firing")
 
+#: The phase-labeled span histogram phase attribution reads.
+SPAN_METRIC = "serve_span_seconds"
+
+
+def phase_attribution(window, window_s: float) -> "dict | None":
+    """Which lifecycle phase's share of served latency GREW in the recent
+    window, vs the pre-window cumulative baseline — the first question a
+    latency page asks ("where did my p99 go"), answered by subtraction
+    from the contiguous-span invariant instead of by a human diffing
+    histograms. Returns None without enough data (cold start, no served
+    requests in the window, no pre-window baseline)."""
+    phases = window.label_values(SPAN_METRIC, "phase")
+    if not phases:
+        return None
+    recent: dict = {}
+    totals: dict = {}
+    for p in phases:
+        h = window.hist_increase(SPAN_METRIC, window_s, phase=p)
+        recent[p] = h["sum"] if h else 0.0
+        t = window.hist_total(SPAN_METRIC, phase=p)
+        totals[p] = t["sum"] if t else 0.0
+    recent_total = sum(recent.values())
+    # Baseline excludes the window itself, so a regression present since
+    # step 0 still shows as zero delta (nothing *changed*) while a fresh
+    # one stands out.
+    baseline = {p: max(0.0, totals[p] - recent[p]) for p in phases}
+    base_total = sum(baseline.values())
+    if recent_total <= 0 or base_total <= 0:
+        return None
+    shares = {p: recent[p] / recent_total for p in phases}
+    base_shares = {p: baseline[p] / base_total for p in phases}
+    delta = {p: shares[p] - base_shares[p] for p in phases}
+    regressed = max(delta, key=lambda p: delta[p])
+    return {
+        "window_s": float(window_s),
+        "shares": {p: round(v, 4) for p, v in shares.items()},
+        "baseline_shares": {p: round(v, 4) for p, v in base_shares.items()},
+        "delta": {p: round(v, 4) for p, v in delta.items()},
+        "regressed_phase": regressed,
+        "regressed_delta": round(delta[regressed], 4),
+    }
+
 
 class AlertState:
     """One alert's ``inactive → pending → firing`` machine.
@@ -146,6 +188,7 @@ class SLOEvaluator:
                 )
                 self._m_active.set(0.0, alert=name, severity=bw.severity)
         self.transitions: collections.deque = collections.deque(maxlen=256)
+        self.last_phase_attribution: "dict | None" = None
         self._last_burns: dict = {}
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
@@ -247,6 +290,18 @@ class SLOEvaluator:
                 "window_short_s": bw.short_s,
             },
         }
+        if obj.kind == "latency" and new in ("pending", "firing"):
+            # A latency alert names its suspect: the span phase whose
+            # share of served latency grew over the alert's long window.
+            try:
+                pa = phase_attribution(self.window, bw.long_s)
+            except Exception:  # noqa: BLE001 — attribution is advisory
+                pa = None
+            if pa is not None:
+                ev["attrs"]["phase_attribution"] = pa
+                self.last_phase_attribution = {
+                    "alert": st.name, "ts": ev["ts"], **pa,
+                }
         self.transitions.append(ev)
         if self._flight is not None:
             self._flight.record(ev)
@@ -289,6 +344,7 @@ class SLOEvaluator:
         return {
             "slos": slos,
             "alerts": [a.snapshot() for a in self.alerts.values()],
+            "phase_attribution": self.last_phase_attribution,
             "transitions": list(self.transitions)[-20:],
             "autoscale": (
                 self.autoscaler.state() if self.autoscaler is not None

@@ -75,6 +75,19 @@ CATALOG: "dict[str, MetricSpec]" = {
         "h2d_stage, device_compute. Contiguous: they sum to the "
         "end-to-end latency.",
     ),
+    "serve_phase_share": MetricSpec(
+        "gauge", ("phase",),
+        "Share of each lifecycle phase (queue_wait, batch_form, "
+        "h2d_stage, device_compute) in cumulative served latency — the "
+        "live phase mix a latency alert's attribution delta is computed "
+        "against.",
+    ),
+    "serve_client_overhead_seconds": MetricSpec(
+        "histogram", (),
+        "Client-observed latency minus the engine's own e2e latency for "
+        "the same request — the client/router-hop cost federation "
+        "attributes when traces cross processes.",
+    ),
     "serve_warm_latency_seconds": MetricSpec(
         "gauge", ("bucket",),
         "First post-compile execution latency per bucket, measured at "
@@ -118,6 +131,17 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Advisory replica count a fleet controller should run, from "
         "windowed queue depth + rejection rate + page burn with "
         "hysteresis and cooldown (telemetry/autoscale.py).",
+    ),
+    # -- federation (mpi4dl_tpu/telemetry/federation.py) ---------------------
+    "federation_replicas": MetricSpec(
+        "gauge", ("state",),
+        "Replicas the federation aggregator knows about: configured "
+        "(scrape targets) and up (last /snapshotz scrape succeeded).",
+    ),
+    "federation_scrapes_total": MetricSpec(
+        "counter", ("replica", "outcome"),
+        "Aggregator /snapshotz scrapes per replica, by outcome (ok, "
+        "error).",
     ),
     # -- trace attribution (mpi4dl_tpu/analysis/trace.py) --------------------
     "trace_attribution_seconds": MetricSpec(

@@ -9,7 +9,12 @@ daemon ``http.server`` thread — stdlib only (the container must not need
 ``python -m mpi4dl_tpu.serve --metrics-port`` (port 0 binds an ephemeral
 port, reported back on :attr:`MetricsServer.port`).
 
-Routes: ``/metrics`` scrapes the registry; ``/`` returns a small text
+Routes: ``/metrics`` scrapes the registry; ``/snapshotz`` serves the same
+registry state as machine-readable JSON — a schema-valid ``metrics`` event
+(:func:`mpi4dl_tpu.telemetry.jsonl.metrics_event`) plus the emitting
+``pid``, the endpoint the federation aggregator
+(:mod:`mpi4dl_tpu.telemetry.federation`) scrapes so child→parent merges
+never round-trip through text-format parsing; ``/`` returns a small text
 index of the endpoints this server actually has (an operator probing the
 port discovers the surface instead of guessing paths); with providers
 attached, ``/healthz`` answers 200/503 from a
@@ -24,9 +29,12 @@ non-GET/HEAD methods get 405.
 from __future__ import annotations
 
 import json
+import os
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from mpi4dl_tpu.telemetry.jsonl import metrics_event
 from mpi4dl_tpu.telemetry.registry import MetricsRegistry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -42,6 +50,30 @@ def escape_label_value(text: str) -> str:
     return (
         text.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
     )
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPE_MAP = {"\\": "\\", "n": "\n", '"': '"'}
+
+
+def _unescape(text: str) -> str:
+    # Single left-to-right pass: 'a\\nb' is backslash+n (literal), not a
+    # newline — sequential str.replace calls get exactly that case wrong,
+    # which is why these exist as the tested inverse of the escapers.
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), m.group(0)), text
+    )
+
+
+def unescape_help(text: str) -> str:
+    r"""Inverse of :func:`escape_help` (``\\`` → backslash, ``\n`` →
+    newline; anything else passes through untouched)."""
+    return _unescape(text)
+
+
+def unescape_label_value(text: str) -> str:
+    r"""Inverse of :func:`escape_label_value`."""
+    return _unescape(text)
 
 
 def _fmt_value(v: float) -> str:
@@ -134,6 +166,11 @@ class MetricsServer:
                 if path == "/metrics":
                     return (200, CONTENT_TYPE,
                             render_prometheus(server.registry).encode())
+                if path == "/snapshotz":
+                    snap = metrics_event(server.registry)
+                    snap["pid"] = os.getpid()
+                    return (200, "application/json",
+                            json.dumps(snap).encode())
                 if path == "/healthz" and server.health is not None:
                     snap = dict(server.health())
                     status = 200 if snap.get("healthy") else 503
@@ -199,6 +236,8 @@ class MetricsServer:
         lines = [
             "mpi4dl_tpu telemetry endpoints:",
             "  /metrics  Prometheus text exposition (0.0.4)",
+            "  /snapshotz  registry snapshot as JSON (metrics-event "
+            "schema + pid; the federation scrape surface)",
         ]
         if self.health is not None:
             lines.append("  /healthz  liveness JSON, 200 healthy / 503 not")

@@ -1,0 +1,642 @@
+"""Telemetry federation: merge N replica registries into one fleet view.
+
+Every observability layer built so far — registry, spans, windows, SLO
+evaluator, autoscaler — lives inside ONE process, while the system the
+ROADMAP is heading for (a router fanning requests out to N single-chip
+``ServingEngine`` replicas) is inherently multi-process, the same way
+MPI4DL's 5D parallelism runs one rank per device. This module is the
+cross-process substrate: a :class:`FederatedAggregator` scrapes each
+child's machine-readable ``/snapshotz`` endpoint (the JSON twin of
+``/metrics`` — no text-format parse on the hot path) and merges the
+snapshots into one registry-shaped view with per-replica attribution:
+
+- **counters** are summed across replicas per label set (the fleet's
+  ``serve_requests_total{outcome=}`` is the sum of its parts — exactly
+  what the availability SLO needs);
+- **histograms** are merged bucket-wise (cumulative ``le`` counts, sums,
+  and counts add — percentile and latency-SLO math over the merged
+  buckets is exact, not an average-of-percentiles);
+- **gauges** keep one series per replica under an injected ``replica``
+  label (attribution: WHICH replica's queue is deep) plus ``min`` /
+  ``max`` / ``sum`` rollup series (``replica="sum"`` et al — reserved
+  replica names).
+
+The merged view (:class:`FederatedRegistry`) answers the same
+``snapshot()`` / ``get()`` protocol as a :class:`MetricsRegistry`, layered
+over a real local registry for the aggregator's own publications — so a
+:class:`~mpi4dl_tpu.telemetry.alerts.SLOEvaluator` and
+:class:`~mpi4dl_tpu.telemetry.autoscale.Autoscaler` run FLEET-WIDE
+unchanged (``SnapshotWindow`` queries fall back to the ``replica="sum"``
+rollup for unlabeled gauge lookups), and a :class:`MetricsServer` over it
+re-exports the whole fleet as one scrape — federation nests.
+
+Span segments from the replicas' JSONL logs join by ``trace_id``
+(:func:`mpi4dl_tpu.telemetry.spans.group_spans_by_trace`) and export as a
+Chrome trace via ``python -m mpi4dl_tpu.analyze trace-export``.
+
+Runnable standalone (the zero-to-fleet-dashboard path)::
+
+    python -m mpi4dl_tpu.telemetry.federation \
+        --replica r0=http://127.0.0.1:9100 \
+        --replica r1=http://127.0.0.1:9101 --port 9200
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+from mpi4dl_tpu.telemetry.registry import MetricsRegistry
+
+#: Gauge rollup series injected next to the per-replica ones; replica
+#: names must not collide with them.
+ROLLUPS = ("sum", "min", "max")
+
+_REPLICA_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+
+def _series_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def merge_snapshots(children: "dict[str, dict]") -> "tuple[dict, list]":
+    """Merge per-replica ``registry.snapshot()`` dicts into one
+    snapshot-shaped dict (see module doc for the per-type semantics).
+    Returns ``(merged, conflicts)`` where ``conflicts`` lists
+    human-readable notes for series that could not be merged (a replica
+    disagreeing about a metric's type/labels/buckets) — dropped rather
+    than silently mis-summed, surfaced rather than silently dropped."""
+    merged: dict = {}
+    conflicts: "list[str]" = []
+    for replica in sorted(children):
+        snap = children[replica]
+        for name, m in snap.items():
+            out = merged.get(name)
+            if out is None:
+                labels = list(m.get("labels", ()))
+                if m["type"] == "gauge":
+                    labels = labels + ["replica"]
+                out = merged[name] = {
+                    "type": m["type"],
+                    "help": m.get("help", ""),
+                    "labels": labels,
+                    "series": [],
+                    "_acc": {},
+                }
+            elif out["type"] != m["type"] or (
+                m["type"] != "gauge"
+                and list(m.get("labels", ())) != out["labels"]
+            ) or (
+                m["type"] == "gauge"
+                and list(m.get("labels", ())) + ["replica"] != out["labels"]
+            ):
+                conflicts.append(
+                    f"{replica}:{name}: type/labels disagree with an "
+                    "earlier replica — skipped"
+                )
+                continue
+            for s in m["series"]:
+                _merge_series(out, m["type"], replica, s, name, conflicts)
+    for name, out in merged.items():
+        out["series"] = _finalize(out.pop("_acc"), out["type"])
+    return merged, conflicts
+
+
+def _merge_series(out, mtype, replica, s, name, conflicts) -> None:
+    acc = out["_acc"]
+    key = _series_key(s["labels"])
+    if mtype == "counter":
+        cur = acc.get(key)
+        acc[key] = {
+            "labels": dict(s["labels"]),
+            "value": (0.0 if cur is None else cur["value"]) + s["value"],
+        }
+    elif mtype == "gauge":
+        per = acc.setdefault(key, {"labels": dict(s["labels"]), "by": {}})
+        per["by"][replica] = s["value"]
+    else:  # histogram
+        cur = acc.get(key)
+        if cur is None:
+            acc[key] = {
+                "labels": dict(s["labels"]),
+                "count": s["count"],
+                "sum": s["sum"],
+                "buckets": dict(s["buckets"]),
+            }
+        elif set(cur["buckets"]) != set(s["buckets"]):
+            conflicts.append(
+                f"{replica}:{name}: histogram bucket bounds disagree — "
+                "series skipped"
+            )
+        else:
+            cur["count"] += s["count"]
+            cur["sum"] += s["sum"]
+            for le, n in s["buckets"].items():
+                cur["buckets"][le] += n
+    out["_acc"] = acc
+
+
+def _finalize(acc: dict, mtype: str) -> "list[dict]":
+    if mtype != "gauge":
+        return list(acc.values())
+    series = []
+    for per in acc.values():
+        vals = list(per["by"].values())
+        for replica, v in sorted(per["by"].items()):
+            series.append({
+                "labels": {**per["labels"], "replica": replica}, "value": v,
+            })
+        for roll, v in (
+            ("sum", sum(vals)), ("min", min(vals)), ("max", max(vals)),
+        ):
+            series.append({
+                "labels": {**per["labels"], "replica": roll}, "value": v,
+            })
+    return series
+
+
+class _MergedMetricView:
+    """Read-only metric protocol (``kind`` / ``snapshot_series()`` /
+    ``value()`` / ``buckets``) over one merged-snapshot entry, so SLO
+    cumulative math (:func:`mpi4dl_tpu.telemetry.slo.cumulative_sli`)
+    reads federated metrics exactly like local ones."""
+
+    def __init__(self, name: str, entry: dict):
+        self.name = name
+        self.kind = entry["type"]
+        self.help = entry.get("help", "")
+        self.labelnames = tuple(entry.get("labels", ()))
+        self._series = entry["series"]
+
+    def snapshot_series(self) -> "list[dict]":
+        return [dict(s) for s in self._series]
+
+    def value(self, **labels) -> "float | None":
+        want = {k: str(v) for k, v in labels.items()}
+        for s in self._series:
+            if s["labels"] == want:
+                return s["value"]
+        return None
+
+    @property
+    def buckets(self) -> tuple:
+        for s in self._series:
+            if "buckets" in s:
+                return tuple(
+                    float(le) for le in s["buckets"] if le != "+Inf"
+                )
+        return ()
+
+
+class FederatedRegistry:
+    """A merged fleet snapshot layered over a real local registry.
+
+    Write API (``counter``/``gauge``/``histogram``, i.e. everything
+    :func:`mpi4dl_tpu.telemetry.declare` needs) delegates to the local
+    registry — the aggregator's own meta-metrics and a fleet-level SLO
+    evaluator's ``slo_*`` gauges live there. Read API (``snapshot`` /
+    ``get`` / ``names``) returns the union, LOCAL WINNING on a name
+    clash: a fleet-level ``slo_burn_rate`` shadows the per-replica ones
+    (whose label shape it couldn't share anyway).
+    """
+
+    def __init__(self, local: "MetricsRegistry | None" = None):
+        self.local = local if local is not None else MetricsRegistry()
+        self._lock = threading.Lock()
+        self._merged: dict = {}
+
+    # -- write API (delegated) ------------------------------------------------
+
+    def counter(self, *a, **kw):
+        return self.local.counter(*a, **kw)
+
+    def gauge(self, *a, **kw):
+        return self.local.gauge(*a, **kw)
+
+    def histogram(self, *a, **kw):
+        return self.local.histogram(*a, **kw)
+
+    # -- merged state ---------------------------------------------------------
+
+    def set_merged(self, merged: dict) -> None:
+        with self._lock:
+            self._merged = merged
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            merged = dict(self._merged)
+        out = {
+            name: {k: v for k, v in entry.items()}
+            for name, entry in merged.items()
+        }
+        out.update(self.local.snapshot())  # local wins
+        return out
+
+    def get(self, name: str):
+        local = self.local.get(name)
+        if local is not None:
+            return local
+        with self._lock:
+            entry = self._merged.get(name)
+        return None if entry is None else _MergedMetricView(name, entry)
+
+    def names(self) -> "list[str]":
+        with self._lock:
+            merged = set(self._merged)
+        return sorted(merged | set(self.local.names()))
+
+
+class ReplicaTarget:
+    """One scrape target: a replica's telemetry base URL + scrape state."""
+
+    def __init__(self, name: str, base_url: str):
+        if not _REPLICA_RE.match(name) or name in ROLLUPS:
+            raise ValueError(
+                f"invalid replica name {name!r} (reserved: {ROLLUPS})"
+            )
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.snapshot: "dict | None" = None
+        self.pid: "int | None" = None
+        self.last_ok_ts: "float | None" = None
+        self.last_error: "str | None" = None
+        self.consecutive_failures = 0
+
+    def state(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.base_url,
+            "pid": self.pid,
+            "up": self.consecutive_failures == 0
+            and self.snapshot is not None,
+            "last_ok_ts": self.last_ok_ts,
+            "last_error": self.last_error,
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+class FederatedAggregator:
+    """Scrape N replicas' ``/snapshotz``, merge, re-expose, evaluate.
+
+    replicas: ``{name: base_url}`` (e.g. ``{"r0":
+        "http://127.0.0.1:9100"}``); more via :meth:`add_replica`.
+    registry: the LOCAL registry for the aggregator's own metrics
+        (``federation_replicas``, ``federation_scrapes_total``) and any
+        fleet-level evaluator output; None creates a private one. The
+        merged view lives on :attr:`registry` (a
+        :class:`FederatedRegistry` wrapping it).
+    slo: a :class:`~mpi4dl_tpu.telemetry.slo.SLOConfig` — runs the SAME
+        :class:`SLOEvaluator` + :class:`Autoscaler` the engine embeds,
+        but over the federated view: fleet-wide burn rates, alerts, and
+        a desired-replica count derived from the summed queue depth.
+    queue_capacity: the fleet's total queue bound for autoscale
+        thresholds (sum of the replicas' ``max_queue``).
+    interval_s / timeout_s: scrape cadence (daemon thread via
+        :meth:`start`) and per-replica HTTP timeout.
+    clock: injectable for deterministic tests (drives the evaluator's
+        snapshot ring too).
+    """
+
+    def __init__(
+        self,
+        replicas: "dict[str, str] | None" = None,
+        registry: "MetricsRegistry | None" = None,
+        slo=None,
+        queue_capacity: int = 64,
+        interval_s: float = 1.0,
+        timeout_s: float = 2.0,
+        clock=time.monotonic,
+        start: bool = False,
+    ):
+        from mpi4dl_tpu import telemetry
+
+        self.registry = FederatedRegistry(local=registry)
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        self._targets: "dict[str, ReplicaTarget]" = {}
+        self._lock = threading.Lock()
+        self.conflicts: "list[str]" = []
+        self._m_replicas = telemetry.declare(
+            self.registry, "federation_replicas"
+        )
+        self._m_scrapes = telemetry.declare(
+            self.registry, "federation_scrapes_total"
+        )
+        self._m_replicas.set(0, state="configured")
+        self._m_replicas.set(0, state="up")
+        for name, url in (replicas or {}).items():
+            self.add_replica(name, url)
+
+        self.slo = None
+        self.autoscaler = None
+        if slo is not None:
+            objectives = slo.objectives()
+            if objectives:
+                self.autoscaler = telemetry.Autoscaler(
+                    registry=self.registry,
+                    config=slo.autoscale,
+                    queue_capacity=queue_capacity,
+                    clock=clock,
+                )
+                self.slo = telemetry.SLOEvaluator(
+                    registry=self.registry,
+                    objectives=objectives,
+                    config=slo,
+                    autoscaler=self.autoscaler,
+                    clock=clock,
+                    start=False,  # the aggregator's tick drives it
+                )
+
+        self.server = None
+        self._stop_evt = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        if start:
+            self.start()
+
+    # -- replica set ----------------------------------------------------------
+
+    def add_replica(self, name: str, base_url: str) -> ReplicaTarget:
+        t = ReplicaTarget(name, base_url)
+        with self._lock:
+            self._targets[name] = t
+            self._m_replicas.set(len(self._targets), state="configured")
+        return t
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._targets.pop(name, None)
+            self._m_replicas.set(len(self._targets), state="configured")
+
+    def replicas(self) -> "list[ReplicaTarget]":
+        with self._lock:
+            return list(self._targets.values())
+
+    # -- scraping + merging ---------------------------------------------------
+
+    def scrape_once(self, now: "float | None" = None) -> dict:
+        """One federation tick: scrape every replica's ``/snapshotz``,
+        merge, refresh the federated view (+ the fleet SLO evaluation
+        when configured). A failed scrape keeps the replica's LAST
+        snapshot in the merge (counters freeze rather than dropping to
+        zero, which would read as a restart) and counts the error."""
+        now = self._clock() if now is None else float(now)
+        up = 0
+        for t in self.replicas():
+            try:
+                with urllib.request.urlopen(
+                    t.base_url + "/snapshotz", timeout=self.timeout_s
+                ) as resp:
+                    payload = json.loads(resp.read().decode())
+                t.snapshot = payload["metrics"]
+                t.pid = payload.get("pid")
+                t.last_ok_ts = now
+                t.last_error = None
+                t.consecutive_failures = 0
+                self._m_scrapes.inc(replica=t.name, outcome="ok")
+            except Exception as e:  # noqa: BLE001 — a down replica is a
+                # data point, not an aggregator crash
+                t.last_error = f"{type(e).__name__}: {e}"
+                t.consecutive_failures += 1
+                self._m_scrapes.inc(replica=t.name, outcome="error")
+            if t.consecutive_failures == 0 and t.snapshot is not None:
+                up += 1
+        merged, conflicts = merge_snapshots({
+            t.name: t.snapshot
+            for t in self.replicas()
+            if t.snapshot is not None
+        })
+        self.registry.set_merged(merged)
+        self.conflicts = conflicts
+        self._m_replicas.set(up, state="up")
+        if self.slo is not None:
+            try:
+                self.slo.evaluate_once(now)
+            except Exception:  # noqa: BLE001 — fleet evaluation is a
+                pass  # sidecar; the scrape loop must survive it
+        return merged
+
+    # -- surfaces -------------------------------------------------------------
+
+    def health_snapshot(self) -> dict:
+        """Aggregated fleet health (the federated ``/healthz`` source):
+        healthy iff every configured replica scraped OK last round."""
+        targets = [t.state() for t in self.replicas()]
+        down = [t["name"] for t in targets if not t["up"]]
+        return {
+            "healthy": not down and bool(targets),
+            "reason": "ok" if not down else f"replicas down: {down}",
+            "replicas": targets,
+        }
+
+    def state(self) -> dict:
+        return {
+            "replicas": [t.state() for t in self.replicas()],
+            "conflicts": list(self.conflicts),
+            "interval_s": self.interval_s,
+            "slo": self.slo.state() if self.slo is not None else None,
+        }
+
+    def serve(self, port: int = 0, host: str = "127.0.0.1"):
+        """Expose the federated view as its own scrape surface
+        (``/metrics`` + ``/snapshotz`` over the merged registry — so
+        federation composes hierarchically — ``/healthz`` from the
+        aggregated replica health, ``/debugz`` with scrape state, and
+        ``/alertz`` when a fleet SLO is configured)."""
+        from mpi4dl_tpu.telemetry.export import MetricsServer
+
+        self.server = MetricsServer(
+            self.registry, port=port, host=host,
+            health=self.health_snapshot,
+            debug=self.state,
+            alerts=self.slo.state if self.slo is not None else None,
+        )
+        return self.server
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mpi4dl-federation", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — keep scraping
+                pass
+
+    def close(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+
+
+# -- trace export (python -m mpi4dl_tpu.analyze trace-export) -----------------
+
+
+def _collect_events(paths) -> "list[dict]":
+    """Span events from JSONL files and/or directories of ``*.jsonl``
+    (telemetry logs, flight dumps — any file in the event schema)."""
+    import os
+
+    from mpi4dl_tpu.telemetry.jsonl import read_events
+
+    files: "list[str]" = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(
+                os.path.join(p, f) for f in sorted(os.listdir(p))
+                if f.endswith(".jsonl")
+            )
+        else:
+            files.append(p)
+    events: "list[dict]" = []
+    for f in files:
+        events.extend(read_events(f))
+    return events
+
+
+def trace_export_main(argv=None) -> int:
+    """``python -m mpi4dl_tpu.analyze trace-export LOG... [--trace-id ID]
+    [-o OUT]`` — join span segments from N processes' JSONL logs by
+    trace id and write one Chrome trace (chrome://tracing / Perfetto).
+    Pure JSON: no jax, no devices — safe before any backend setup."""
+    import argparse
+    import sys
+
+    from mpi4dl_tpu.telemetry.spans import chrome_trace, group_spans_by_trace
+
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.analyze trace-export",
+        description="Export federated request traces as a Chrome trace",
+    )
+    p.add_argument("logs", nargs="+",
+                   help="JSONL telemetry logs / flight dumps, or "
+                        "directories of them (N processes' logs join)")
+    p.add_argument("--trace-id", default=None,
+                   help="export one request's full cross-process "
+                        "lifetime (default: every trace in the logs)")
+    p.add_argument("--list", action="store_true",
+                   help="list trace ids (with span/process counts) "
+                        "instead of exporting")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: stdout)")
+    args = p.parse_args(argv)
+
+    events = _collect_events(args.logs)
+    groups = group_spans_by_trace(events)
+    if args.list:
+        for tid in sorted(groups):
+            evs = groups[tid]
+            pids = sorted({e.get("attrs", {}).get("pid", 0) for e in evs})
+            spans = sum(len(e["spans"]) for e in evs)
+            print(
+                f"{tid}  segments={len(evs)} spans={spans} "
+                f"pids={','.join(str(x) for x in pids)}"
+            )
+        print(f"# {len(groups)} trace(s)", file=sys.stderr)
+        return 0
+    doc = chrome_trace(events, trace_id=args.trace_id)
+    n = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    if n == 0:
+        print(
+            "trace-export: no matching span events"
+            + (f" for trace id {args.trace_id!r}" if args.trace_id else ""),
+            file=sys.stderr,
+        )
+        return 1
+    text = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    pids = {e["pid"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    print(
+        f"# trace-export: {n} span(s) across {len(pids)} process(es)"
+        + (f" -> {args.out}" if args.out else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
+# -- standalone aggregator CLI ------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """``python -m mpi4dl_tpu.telemetry.federation`` — run an aggregator
+    over N replica endpoints and re-serve the merged fleet view."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.telemetry.federation",
+        description="Federated telemetry aggregator over replica "
+                    "/snapshotz endpoints",
+    )
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="NAME=URL", required=True,
+                   help="replica to scrape, e.g. "
+                        "r0=http://127.0.0.1:9100 (repeatable)")
+    p.add_argument("--port", type=int, default=0,
+                   help="serve the federated /metrics + /snapshotz here "
+                        "(0 = ephemeral, echoed on stderr)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="scrape cadence, seconds")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-replica scrape timeout, seconds")
+    p.add_argument("--once", action="store_true",
+                   help="scrape once, print the merged snapshot JSON to "
+                        "stdout, exit (nonzero if any replica failed)")
+    args = p.parse_args(argv)
+
+    replicas = {}
+    for spec in args.replica:
+        name, sep, url = spec.partition("=")
+        if not sep:
+            p.error(f"--replica must be NAME=URL, got {spec!r}")
+        replicas[name] = url
+    agg = FederatedAggregator(
+        replicas=replicas, interval_s=args.interval, timeout_s=args.timeout,
+    )
+    if args.once:
+        merged = agg.scrape_once()
+        print(json.dumps({"ts": time.time(), "kind": "metrics",
+                          "metrics": merged}))
+        health = agg.health_snapshot()
+        print(f"# {health['reason']}", file=sys.stderr)
+        return 0 if health["healthy"] else 1
+    agg.serve(port=args.port)
+    print(
+        f"# federation: http://127.0.0.1:{agg.server.port}/metrics "
+        f"(merged; also /snapshotz, /healthz, /debugz) — scraping "
+        f"{len(replicas)} replica(s) every {args.interval:g}s",
+        file=sys.stderr, flush=True,
+    )
+    agg.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        agg.close()
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via tests
+    import sys
+
+    sys.exit(main())

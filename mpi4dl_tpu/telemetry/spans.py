@@ -12,9 +12,21 @@ JSONL logs, and the property that makes "where did my p99 go" answerable
 by subtraction).
 
 Span events are JSONL records (:mod:`mpi4dl_tpu.telemetry.jsonl`) keyed by
-a process-unique ``trace_id`` that :func:`mpi4dl_tpu.profiling.annotate_step`
-aligns with XProf step annotations, so a device-timeline trace and the
-host-side span log can be joined on the same ids.
+a ``trace_id`` that :func:`mpi4dl_tpu.profiling.annotate_step` aligns with
+XProf step annotations, so a device-timeline trace and the host-side span
+log can be joined on the same ids.
+
+Distributed tracing: a trace id is globally unique (pid + a per-process
+random component + a monotonic counter — see :func:`new_trace_id`), so
+span events emitted by DIFFERENT processes for the SAME logical request
+(a load-generator client and the replica engine that served it; tomorrow,
+a fleet router and N replicas) join under one id. The client creates the
+id and hands it down (``ServingEngine.submit(trace_id=...)``); each
+process emits its own span *segment*; :func:`group_spans_by_trace`
+re-joins the segments and :func:`chrome_trace` renders the joined
+lifetime — client → queue → batch → device — as a Chrome trace
+(``chrome://tracing`` / Perfetto), one process per track
+(``python -m mpi4dl_tpu.analyze trace-export``).
 """
 
 from __future__ import annotations
@@ -27,12 +39,32 @@ import time
 _counter = itertools.count()
 _counter_lock = threading.Lock()
 
+# Per-process random tag, computed lazily so a fork (supervised replica
+# restart, multiprocessing worker) gets a fresh one: pid alone is NOT
+# collision-proof across a fleet — pids recycle, and two hosts can share a
+# pid space — so the tag carries 32 random bits next to the pid.
+_proc_tag: "str | None" = None
+_proc_tag_pid: "int | None" = None
+
+
+def _process_tag() -> str:
+    global _proc_tag, _proc_tag_pid
+    pid = os.getpid()
+    if _proc_tag is None or _proc_tag_pid != pid:
+        _proc_tag = f"{pid:x}-{os.urandom(4).hex()}"
+        _proc_tag_pid = pid
+    return _proc_tag
+
 
 def new_trace_id(prefix: str = "req") -> str:
-    """Process-unique, monotonic, human-greppable trace id."""
+    """Globally-unique, per-process-monotonic, human-greppable trace id:
+    ``<prefix>-<pid hex>-<random32 hex>-<counter>``. Safe to mint in N
+    replica processes whose spans will later be federated into one
+    stream — ids cannot collide across processes (pid + 32 random bits)
+    and stay orderable within one (the counter)."""
     with _counter_lock:
         n = next(_counter)
-    return f"{prefix}-{os.getpid():x}-{n}"
+    return f"{prefix}-{_process_tag()}-{n}"
 
 
 def spans_from_marks(marks: "list[tuple[str, float]]") -> "list[dict]":
@@ -87,3 +119,94 @@ def record_spans(histogram, spans: "list[dict]") -> None:
     without replaying the JSONL log."""
     for s in spans:
         histogram.observe(s["duration_s"], phase=s["phase"])
+
+
+# -- joining + export across processes ----------------------------------------
+
+
+def group_spans_by_trace(events) -> "dict[str, list[dict]]":
+    """Join span events (possibly from N processes' JSONL logs) by
+    ``trace_id``; within a trace, segments are ordered by wall-clock
+    start. The aggregator-side half of distributed tracing: each process
+    only ever emits its own segment."""
+    out: "dict[str, list[dict]]" = {}
+    for ev in events:
+        if ev.get("kind") != "span" or not ev.get("trace_id"):
+            continue
+        out.setdefault(ev["trace_id"], []).append(ev)
+    for evs in out.values():
+        evs.sort(key=_event_wall_start)
+    return out
+
+
+def _event_wall_start(ev: dict) -> float:
+    """Wall-clock time of the event's first span. Span marks are
+    per-process ``time.monotonic`` values, NOT comparable across
+    processes; the event's ``ts`` (``time.time`` at emission, which
+    happens at the final span boundary) anchors them to a shared clock:
+    wall(mark) = ts - (last_end - mark)."""
+    spans = ev["spans"]
+    return ev["ts"] - (spans[-1]["end_s"] - spans[0]["start_s"])
+
+
+def chrome_trace(
+    events,
+    trace_id: "str | None" = None,
+    process_names: "dict[int, str] | None" = None,
+) -> dict:
+    """Span events from any number of processes → a Chrome trace dict
+    (``{"traceEvents": [...]}`` — load in chrome://tracing or Perfetto).
+
+    Each span becomes a complete event (``ph="X"``) on the track
+    ``pid`` = emitting process (``attrs["pid"]``, 0 when absent),
+    ``tid`` = one row per trace within the process, so a request's full
+    cross-process lifetime reads top-to-bottom: the client segment on the
+    client process's track, queue→batch→device on the replica's.
+    Monotonic span marks are anchored to wall clock per event (see
+    :func:`_event_wall_start`) and the whole trace is normalized to start
+    at t=0. ``trace_id`` exports one request; None exports every trace in
+    ``events``.
+    """
+    groups = group_spans_by_trace(events)
+    if trace_id is not None:
+        groups = {trace_id: groups.get(trace_id, [])}
+    picked = [(tid, ev) for tid, evs in groups.items() for ev in evs]
+    if not any(ev for _, ev in picked):
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(_event_wall_start(ev) for _, ev in picked)
+    rows: "dict[tuple[int, str], int]" = {}  # (pid, trace_id) -> tid
+    next_row: "dict[int, int]" = {}
+    trace_events: "list[dict]" = []
+    seen_pids: "dict[int, str]" = {}
+    for tid_key, ev in sorted(picked, key=lambda p: _event_wall_start(p[1])):
+        attrs = ev.get("attrs", {})
+        pid = int(attrs.get("pid", 0))
+        if pid not in seen_pids:
+            seen_pids[pid] = (
+                (process_names or {}).get(pid)
+                or attrs.get("process")
+                or attrs.get("role")
+                or f"pid {pid}"
+            )
+        row = rows.get((pid, tid_key))
+        if row is None:
+            row = rows[(pid, tid_key)] = next_row.get(pid, 0)
+            next_row[pid] = row + 1
+        base = _event_wall_start(ev) - ev["spans"][0]["start_s"]
+        for s in ev["spans"]:
+            trace_events.append({
+                "name": s["phase"],
+                "cat": ev["name"],
+                "ph": "X",
+                "ts": (base + s["start_s"] - t0) * 1e6,  # microseconds
+                "dur": s["duration_s"] * 1e6,
+                "pid": pid,
+                "tid": row,
+                "args": {"trace_id": tid_key, **attrs},
+            })
+    for pid, name in seen_pids.items():
+        trace_events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

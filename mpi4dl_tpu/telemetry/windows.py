@@ -26,6 +26,12 @@ Window semantics (documented because alerting math depends on them):
   e.g. the first ``rejected_queue_full`` — must count, not vanish).
 - A negative delta means the underlying counter restarted; the query
   returns None (no data) rather than a fabricated value.
+- Federation fallback: when an exact label match fails and the series
+  carries the aggregator-injected ``replica`` label
+  (:mod:`mpi4dl_tpu.telemetry.federation`), the query falls back to the
+  ``replica="sum"`` rollup — so an unlabeled ``serve_queue_depth`` lookup
+  against a FEDERATED snapshot answers with the fleet total, and the SLO
+  evaluator / autoscaler run fleet-wide unchanged.
 """
 
 from __future__ import annotations
@@ -63,6 +69,12 @@ def _find_series(snap: dict, name: str, labels: dict) -> "dict | None":
     for s in m["series"]:
         if s["labels"] == want:
             return s
+    if "replica" not in want:
+        # Federated gauge: fall back to the fleet-wide rollup series.
+        want_sum = dict(want, replica="sum")
+        for s in m["series"]:
+            if s["labels"] == want_sum:
+                return s
     return None
 
 
@@ -132,6 +144,34 @@ class SnapshotWindow:
             _, snap = self._ring[-1]
         s = _find_series(snap, name, labels)
         return None if s is None else s["value"]
+
+    def label_values(self, name: str, label: str) -> "list[str]":
+        """Distinct values of one label across the newest snapshot's
+        series of a metric (e.g. the phases ``serve_span_seconds`` has
+        actually seen) — sorted, empty without data."""
+        with self._lock:
+            if not self._ring:
+                return []
+            _, snap = self._ring[-1]
+        m = snap.get(name)
+        if m is None:
+            return []
+        return sorted({
+            s["labels"][label] for s in m["series"] if label in s["labels"]
+        })
+
+    def hist_total(self, name: str, **labels) -> "dict | None":
+        """Cumulative ``{"count", "sum"}`` of a histogram series in the
+        newest snapshot (the process-lifetime baseline windowed deltas
+        are compared against)."""
+        with self._lock:
+            if not self._ring:
+                return None
+            _, snap = self._ring[-1]
+        s = _find_series(snap, name, labels)
+        if s is None or "buckets" not in s:
+            return None
+        return {"count": s["count"], "sum": s["sum"]}
 
     # -- windowed queries -----------------------------------------------------
 

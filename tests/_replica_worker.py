@@ -1,0 +1,62 @@
+"""Two-replica federation drill worker (tests/test_federation.py).
+
+One tiny ServingEngine in its own process: binds an ephemeral metrics
+port (printed as ``PORT <n>`` on stdout), then serves one request per
+trace id handed on stdin — the ids are minted by the PARENT process, so
+the engine's span segments join the parent's client segments under the
+same trace ids across the process hop. Exits 0 after stdin closes with
+``SERVED <n>`` on stdout.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    telemetry_dir = sys.argv[1]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.utils import get_depth
+
+    size = 16
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=size // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+    engine = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3), max_batch=2,
+        default_deadline_s=30.0, metrics_port=0, telemetry_dir=telemetry_dir,
+    )
+    print(f"PORT {engine.metrics_port}", flush=True)
+    engine.start()
+    example = rng.standard_normal((size, size, 3)).astype(np.float32)
+    futures = []
+    for line in sys.stdin:
+        trace_id = line.strip()
+        if not trace_id or trace_id == "DONE":
+            break
+        futures.append(engine.submit(example, trace_id=trace_id))
+    for f in futures:
+        f.result(timeout=60)
+    engine.stop()
+    print(f"SERVED {len(futures)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,16 +11,12 @@ virtual CPU devices (conftest), covering the VERDICT-r3 flag matrix:
 ``--eval-batches``, and ``--times 2``.
 """
 
+import json
 import os
 import runpy
 import sys
 
 import pytest
-
-# Every case compiles a full model on the CPU mesh — minutes each. The fast
-# tier's engine coverage lives in the golden tests; these are the
-# integration layer.
-pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 B = os.path.join(REPO, "benchmarks")
@@ -84,6 +80,11 @@ CASES = {
 }
 
 
+# Every case compiles a full model on the CPU mesh — minutes each. The fast
+# tier's engine coverage lives in the golden tests; these are the
+# integration layer. (Marked per-test, not module-wide: the pure-JSON CLI
+# smokes below belong to the fast tier.)
+@pytest.mark.slow
 @pytest.mark.parametrize("script", sorted(CASES), ids=lambda s: s.split("/")[-1])
 def test_cli_script_smoke(script, monkeypatch, capsys):
     """Run the script's real __main__ path with a real argv; assert it
@@ -113,3 +114,41 @@ def test_cli_script_smoke(script, monkeypatch, capsys):
         assert "comm-opt" in out, out
     if "--eval-batches" in CASES[script]:
         assert "eval (" in out, out
+
+
+def test_analyze_trace_export_cli(tmp_path, capsys):
+    """ISSUE CI satellite: `python -m mpi4dl_tpu.analyze trace-export`
+    end-to-end through the analysis CLI's real dispatch — two processes'
+    JSONL span segments in, one joined Chrome trace out. Pure JSON (the
+    subcommand dispatches before any jax setup), so it runs in the fast
+    tier."""
+    from mpi4dl_tpu import telemetry
+    from mpi4dl_tpu.analysis.cli import main
+
+    log = tmp_path / "telemetry-fleet.jsonl"
+    with open(log, "w") as f:
+        for pid, name, marks in (
+            (11, "client.request",
+             [("issue", 1.0), ("client_wait", 2.0)]),
+            (22, "serve.request",
+             [("submit", 5.0), ("queue_wait", 5.4),
+              ("device_compute", 5.9)]),
+        ):
+            ev = telemetry.span_event(
+                name, "trace-join-1", telemetry.spans_from_marks(marks),
+                attrs={"pid": pid}, ts=100.0,
+            )
+            f.write(json.dumps(ev) + "\n")
+    out = tmp_path / "chrome.json"
+    rc = main(["trace-export", str(log), "--trace-id", "trace-join-1",
+               "-o", str(out)])
+    assert rc == 0
+    assert "2 process(es)" in capsys.readouterr().err
+    doc = json.load(open(out))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {11, 22}
+    assert all(e["args"]["trace_id"] == "trace-join-1" for e in xs)
+    # --list mode names the trace; a bogus id exits nonzero.
+    assert main(["trace-export", str(log), "--list"]) == 0
+    assert "trace-join-1" in capsys.readouterr().out
+    assert main(["trace-export", str(log), "--trace-id", "missing"]) == 1

@@ -95,6 +95,86 @@ def test_hang_timeout_requires_heartbeat():
         elastic.supervise(["x.py"], hang_timeout=5.0)
 
 
+def test_heartbeat_reporter_gated_on_health(tmp_path):
+    """ISSUE satellite, unit level: beats happen while healthy, stop the
+    moment the health state flips (or the watchdog trips), resume on
+    recovery — the silence the supervisor's staleness detector needs."""
+    from mpi4dl_tpu import telemetry
+
+    hb = tmp_path / "heartbeat"
+    health = telemetry.HealthState()
+    wd = telemetry.Watchdog(min_timeout_s=60.0, start=False)
+    r = elastic.HeartbeatReporter(str(hb), health=health, watchdog=wd)
+    assert r.beat_once() and hb.exists()
+    os.utime(hb, (0, 0))
+    health.set_unhealthy("batcher crashed")
+    assert not r.beat_once()
+    assert os.path.getmtime(hb) == 0  # untouched while unhealthy
+    health.set_healthy()
+    assert r.beat_once()
+    assert os.path.getmtime(hb) > 0
+    # A tripped watchdog silences beats even with healthy unset state.
+    wd.begin()
+    wd.seed(0.001)
+    assert wd.check(now=1e9) is not None  # force the trip
+    os.utime(hb, (0, 0))
+    assert not r.beat_once()
+    assert os.path.getmtime(hb) == 0
+
+
+def test_supervise_restarts_replica_wedged_behind_live_threads(
+    tmp_path, monkeypatch
+):
+    """ISSUE satellite, fault drill: a serving-shaped replica whose
+    batcher wedges while its OTHER threads stay alive. An unconditional
+    heartbeat would stay fresh forever; the health-gated
+    HeartbeatReporter goes silent when the watchdog trips, so
+    supervise() kills the wedged process and the restarted one
+    completes."""
+    # supervise() inherits our env; the worker imports mpi4dl_tpu from
+    # the repo (APPEND, as in the end-to-end test below — the TPU
+    # runtime delivers its plugin via PYTHONPATH).
+    monkeypatch.setenv(
+        "PYTHONPATH", REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+    hb = tmp_path / "heartbeat"
+    w = _worker(
+        tmp_path,
+        """
+        import os, sys, time
+        from mpi4dl_tpu import elastic, telemetry
+        if "--resume" in sys.argv:
+            sys.exit(0)  # the restarted replica is healthy
+        health = telemetry.HealthState()
+        wd = telemetry.Watchdog(
+            factor=1.0, min_timeout_s=0.3, poll_s=0.05, health=health,
+        )
+        hr = elastic.HeartbeatReporter(
+            os.environ[elastic.HEARTBEAT_ENV], health=health,
+            watchdog=wd, interval_s=0.05,
+        )
+        hr.start()
+        wd.begin()        # work admitted...
+        time.sleep(3600)  # ...and the loop wedges; threads stay alive
+        """,
+    )
+    msgs = []
+    rc = elastic.supervise(
+        [w],
+        max_restarts=1,
+        # Covers interpreter + package import (~2s in this image) with
+        # margin; the watchdog trips at 0.3s, so the beats are silent
+        # long before this expires.
+        hang_timeout=6.0,
+        heartbeat_path=str(hb),
+        poll_interval=0.1,
+        _print=msgs.append,
+    )
+    assert rc == 0
+    assert any("killing wedged child" in m for m in msgs)
+    assert any("wedged — restarting" in m for m in msgs)
+
+
 def test_maybe_supervise_noop_without_flag_or_in_child(monkeypatch):
     class A:
         max_restarts = 0

@@ -1,0 +1,503 @@
+"""Telemetry federation + distributed tracing (ISSUE 6 tentpole):
+snapshot-merge goldens (counters summed, histograms bucket-wise,
+per-replica gauges + rollups), the federated registry protocol under the
+windows/SLO/autoscale stack run FLEET-WIDE unchanged, phase attribution
+on latency alerts, the Chrome-trace exporter joining span segments across
+processes, and the live two-replica drill: two spawned engine processes +
+an aggregator, merged counters golden-checked against the children, one
+request's client+engine spans joined under a single trace id.
+"""
+
+import json
+import os
+import select
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.telemetry.alerts import phase_attribution
+from mpi4dl_tpu.telemetry.federation import (
+    FederatedAggregator,
+    FederatedRegistry,
+    ReplicaTarget,
+    merge_snapshots,
+    trace_export_main,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child(served=0, rejected=0, depth=0.0, latencies=()):
+    reg = telemetry.MetricsRegistry()
+    c = telemetry.declare(reg, "serve_requests_total")
+    if served:
+        c.inc(served, outcome="served")
+    if rejected:
+        c.inc(rejected, outcome="rejected_queue_full")
+    telemetry.declare(reg, "serve_queue_depth").set(depth)
+    h = telemetry.declare(reg, "serve_request_latency_seconds")
+    for v in latencies:
+        h.observe(v)
+    return reg
+
+
+# -- merge goldens ------------------------------------------------------------
+
+
+def test_merge_counters_summed_histograms_bucketwise_gauges_per_replica():
+    a = _child(served=90, rejected=10, depth=4, latencies=[0.004, 0.04])
+    b = _child(served=100, depth=10, latencies=[0.4])
+    merged, conflicts = merge_snapshots(
+        {"r0": a.snapshot(), "r1": b.snapshot()}
+    )
+    assert conflicts == []
+
+    # Counters: summed per label set, no replica label injected.
+    c = {
+        tuple(sorted(s["labels"].items())): s["value"]
+        for s in merged["serve_requests_total"]["series"]
+    }
+    assert c[(("outcome", "served"),)] == 190
+    assert c[(("outcome", "rejected_queue_full"),)] == 10
+    assert merged["serve_requests_total"]["labels"] == ["outcome"]
+
+    # Gauges: one series per replica + min/max/sum rollups.
+    g = {
+        s["labels"]["replica"]: s["value"]
+        for s in merged["serve_queue_depth"]["series"]
+    }
+    assert g == {"r0": 4, "r1": 10, "sum": 14, "min": 4, "max": 10}
+    assert merged["serve_queue_depth"]["labels"] == ["replica"]
+
+    # Histograms: bucket-wise merge — counts, sums, and every cumulative
+    # le bucket add exactly (percentile math over the merge is exact).
+    (h,) = merged["serve_request_latency_seconds"]["series"]
+    assert h["count"] == 3
+    assert h["sum"] == pytest.approx(0.444)
+    ha = a.get("serve_request_latency_seconds").snapshot_series()[0]
+    hb = b.get("serve_request_latency_seconds").snapshot_series()[0]
+    for le, n in h["buckets"].items():
+        assert n == ha["buckets"][le] + hb["buckets"][le], le
+
+
+def test_merge_conflicting_series_skipped_not_missummed():
+    a = telemetry.MetricsRegistry()
+    a.counter("m_total", "x", labels=("k",)).inc(1, k="v")
+    b = telemetry.MetricsRegistry()
+    b.gauge("m_total", "x").set(5)  # same name, different type
+    merged, conflicts = merge_snapshots(
+        {"r0": a.snapshot(), "r1": b.snapshot()}
+    )
+    assert len(conflicts) == 1 and "r1:m_total" in conflicts[0]
+    assert merged["m_total"]["type"] == "counter"
+    assert merged["m_total"]["series"][0]["value"] == 1
+
+
+def test_reserved_replica_names_refused():
+    for bad in ("sum", "min", "max", "", "spaced name"):
+        with pytest.raises(ValueError):
+            ReplicaTarget(bad, "http://x")
+
+
+# -- federated registry protocol ---------------------------------------------
+
+
+def test_federated_registry_local_overlay_wins_and_views_work():
+    fed = FederatedRegistry()
+    merged, _ = merge_snapshots({"r0": _child(
+        served=8, latencies=[0.004, 0.004, 0.4]
+    ).snapshot()})
+    fed.set_merged(merged)
+    # declare() writes land on the local layer through the same protocol.
+    telemetry.declare(fed, "federation_replicas").set(1, state="up")
+    snap = fed.snapshot()
+    assert "serve_requests_total" in snap and "federation_replicas" in snap
+    # Merged metric views answer the cumulative-SLI protocol.
+    view = fed.get("serve_request_latency_seconds")
+    assert view.kind == "histogram"
+    assert view.buckets  # parsed float bounds for threshold resolution
+    from mpi4dl_tpu.telemetry.slo import cumulative_sli, latency_objective
+
+    sli = cumulative_sli(fed, latency_objective(0.99, threshold_s=0.005))
+    assert sli == pytest.approx(2 / 3)
+    # Local name shadows a merged one.
+    fed.gauge("serve_queue_depth", "local").set(99)
+    assert fed.get("serve_queue_depth").value() == 99
+    assert fed.snapshot()["serve_queue_depth"]["series"][0]["value"] == 99
+
+
+def test_windows_fall_back_to_replica_sum_rollup():
+    """The autoscaler's unlabeled serve_queue_depth lookup answers with
+    the FLEET total against a federated snapshot — the fallback that
+    lets it run fleet-wide unchanged."""
+    fed = FederatedRegistry()
+    w = telemetry.SnapshotWindow(fed, clock=lambda: 0)
+    for t, (d0, d1) in enumerate(((4, 10), (6, 12))):
+        merged, _ = merge_snapshots({
+            "r0": _child(depth=d0, served=10 * (t + 1)).snapshot(),
+            "r1": _child(depth=d1, served=5 * (t + 1)).snapshot(),
+        })
+        fed.set_merged(merged)
+        w.record(float(t * 10))
+    assert w.value("serve_queue_depth") == 18  # 6 + 12
+    assert w.mean_gauge("serve_queue_depth", 100.0) == pytest.approx(16.0)
+    # Counters merged without replica labels: increase() is fleet-wide.
+    assert w.increase("serve_requests_total", 100.0, outcome="served") == 15
+
+
+# -- fleet-wide SLO evaluation ------------------------------------------------
+
+
+def test_fleet_slo_and_autoscaler_over_live_replicas():
+    """Two in-process 'replicas' behind real /snapshotz endpoints: the
+    aggregator merges them and the UNCHANGED SLOEvaluator + Autoscaler
+    compute fleet-wide burn and a rising desired-replica count."""
+    r = [_child(served=100), _child(served=100)]
+    servers = [telemetry.MetricsServer(x, port=0) for x in r]
+    agg = FederatedAggregator(
+        replicas={
+            f"r{i}": f"http://127.0.0.1:{s.port}"
+            for i, s in enumerate(servers)
+        },
+        slo=telemetry.SLOConfig(availability=0.999, interval_s=1.0),
+        queue_capacity=128,
+        clock=lambda: 0,
+    )
+    try:
+        agg.scrape_once(now=0.0)
+        # Replica 0 starts rejecting hard; replica 1 stays clean.
+        telemetry.declare(r[0], "serve_requests_total").inc(
+            50, outcome="rejected_queue_full"
+        )
+        agg.scrape_once(now=30.0)
+        burn = agg.registry.get("slo_burn_rate").value(
+            slo="availability", window="fast_long"
+        )
+        assert burn is not None and burn > 14.4  # fleet-wide page burn
+        fired = agg.registry.get("alert_active").value(
+            alert="availability_fast_burn", severity="page"
+        )
+        assert fired == 1.0
+        assert (
+            agg.registry.get("autoscale_desired_replicas").value() == 2
+        )  # pressure: fleet rejections
+        # Per-replica scrape accounting.
+        assert agg.registry.get("federation_replicas").value(state="up") == 2
+        assert agg.registry.get("federation_scrapes_total").value(
+            replica="r0", outcome="ok"
+        ) == 2
+        # The federated server re-exposes the merged view + fleet alerts.
+        srv = agg.serve(port=0)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+        assert 'serve_queue_depth{replica="r0"}' in body
+        assert "slo_burn_rate" in body
+        alertz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/alertz", timeout=10
+        ).read())
+        assert any(
+            a["name"] == "availability_fast_burn" and a["state"] == "firing"
+            for a in alertz["alerts"]
+        )
+    finally:
+        agg.close()
+        for s in servers:
+            s.close()
+
+
+def test_aggregator_down_replica_counted_and_health_degrades():
+    reg = _child(served=5)
+    srv = telemetry.MetricsServer(reg, port=0)
+    dead_port = srv.port  # will be closed below — guaranteed-dead target
+    agg = FederatedAggregator(replicas={
+        "up0": f"http://127.0.0.1:{srv.port}",
+    })
+    try:
+        agg.scrape_once()
+        assert agg.health_snapshot()["healthy"]
+        srv.close()
+        agg.add_replica("down0", f"http://127.0.0.1:{dead_port}")
+        agg.scrape_once()
+        h = agg.health_snapshot()
+        assert not h["healthy"] and "down0" in h["reason"]
+        assert agg.registry.get("federation_scrapes_total").value(
+            replica="down0", outcome="error"
+        ) >= 1
+        # The up replica's LAST snapshot stays in the merge (stale, not
+        # vanished — vanishing would read as a counter restart).
+        assert agg.registry.get("serve_requests_total").value(
+            outcome="served"
+        ) == 5
+    finally:
+        agg.close()
+
+
+# -- phase attribution on latency alerts --------------------------------------
+
+
+def test_latency_alert_transition_carries_phase_attribution():
+    """A forced latency regression (queue_wait share explodes) fires the
+    latency alert WITH an attribution payload naming the regressed
+    phase — the ISSUE acceptance drill's alerting half, deterministic."""
+    reg = telemetry.MetricsRegistry()
+    spans = telemetry.declare(reg, "serve_span_seconds")
+    lat = telemetry.declare(reg, "serve_request_latency_seconds")
+
+    def serve(n, queue_s, compute_s):
+        for _ in range(n):
+            spans.observe(queue_s, phase="queue_wait")
+            spans.observe(compute_s, phase="device_compute")
+            lat.observe(queue_s + compute_s)
+
+    cfg = telemetry.SLOConfig(
+        latency_threshold_s=0.025, latency_target=0.99, interval_s=1.0
+    )
+    ev = telemetry.SLOEvaluator(
+        registry=reg, objectives=cfg.objectives(), config=cfg,
+        clock=lambda: 0, start=False,
+    )
+    serve(200, 0.002, 0.008)          # healthy baseline: 10 ms e2e
+    ev.evaluate_once(now=0.0)
+    serve(100, 0.050, 0.008)          # regression: queue wait x25
+    ev.evaluate_once(now=30.0)
+    fired = [a for a in ev.alerts.values() if a.state == "firing"]
+    assert any(a.name == "latency_fast_burn" for a in fired)
+    trans = [
+        t for t in ev.transitions
+        if t["attrs"]["alert"] == "latency_fast_burn"
+        and t["attrs"]["to"] == "firing"
+    ]
+    pa = trans[-1]["attrs"]["phase_attribution"]
+    assert pa["regressed_phase"] == "queue_wait"
+    assert pa["delta"]["queue_wait"] > 0.5
+    assert ev.last_phase_attribution["alert"].startswith("latency_")
+    assert ev.state()["phase_attribution"]["regressed_phase"] == "queue_wait"
+    # Transitions stay schema-valid with the payload attached.
+    telemetry.validate_event(trans[-1])
+
+
+# -- chrome-trace export ------------------------------------------------------
+
+
+def _cross_process_events():
+    client = telemetry.span_event(
+        "client.request", "trace-a",
+        telemetry.spans_from_marks(
+            [("issue", 10.0), ("client_submit", 10.05), ("client_wait", 10.9)]
+        ),
+        attrs={"pid": 111, "role": "client"}, ts=1000.9,
+    )
+    engine = telemetry.span_event(
+        "serve.request", "trace-a",
+        telemetry.spans_from_marks([
+            ("submit", 5.0), ("queue_wait", 5.2), ("batch_form", 5.25),
+            ("h2d_stage", 5.3), ("device_compute", 5.8),
+        ]),
+        attrs={"pid": 222, "role": "engine"}, ts=1000.85,
+    )
+    other = telemetry.span_event(
+        "serve.request", "trace-b",
+        telemetry.spans_from_marks([("submit", 6.0), ("device_compute", 6.1)]),
+        attrs={"pid": 222, "role": "engine"}, ts=1001.0,
+    )
+    return [client, engine, other]
+
+
+def test_chrome_trace_joins_processes_under_one_trace_id():
+    events = _cross_process_events()
+    groups = telemetry.group_spans_by_trace(events)
+    assert set(groups) == {"trace-a", "trace-b"}
+    assert len(groups["trace-a"]) == 2
+
+    doc = telemetry.chrome_trace(events, trace_id="trace-a")
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {111, 222}
+    assert all(e["args"]["trace_id"] == "trace-a" for e in xs)
+    names = {e["name"] for e in xs}
+    assert {"client_wait", "queue_wait", "device_compute"} <= names
+    # Wall-clock alignment: the engine segment sits INSIDE the client's
+    # issue→resolve window (client issued at wall 1000.0, engine submit
+    # at 1000.05, both normalized against the same t0).
+    client_start = min(e["ts"] for e in xs if e["pid"] == 111)
+    engine_start = min(e["ts"] for e in xs if e["pid"] == 222)
+    assert client_start == 0.0
+    assert engine_start == pytest.approx(0.05e6)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {"client", "engine"}
+    # No filter: both traces export.
+    assert len(telemetry.chrome_trace(events)["traceEvents"]) > len(
+        doc["traceEvents"]
+    )
+
+
+def test_trace_export_cli_roundtrip(tmp_path, capsys):
+    log = tmp_path / "telemetry-1.jsonl"
+    with open(log, "w") as f:
+        for ev in _cross_process_events():
+            f.write(json.dumps(ev) + "\n")
+    out = tmp_path / "trace.json"
+    rc = trace_export_main(
+        [str(log), "--trace-id", "trace-a", "-o", str(out)]
+    )
+    assert rc == 0
+    doc = json.load(open(out))
+    assert {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"} == {111, 222}
+    rc = trace_export_main([str(tmp_path), "--list"])
+    assert rc == 0
+    listed = capsys.readouterr().out
+    assert "trace-a" in listed and "trace-b" in listed
+    # Unknown trace id → loud nonzero, not an empty file.
+    assert trace_export_main([str(log), "--trace-id", "nope"]) == 1
+
+
+# -- the live two-replica drill ----------------------------------------------
+
+
+def _read_stdout_line(proc, prefix, deadline):
+    """Timeout-guarded readline: the drill must fail loudly, not hang
+    tier-1, if a replica never comes up."""
+    while time.monotonic() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not ready:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"replica died rc={proc.returncode}: "
+                    f"{proc.stderr.read()[-2000:]}"
+                )
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"replica stdout closed: {proc.stderr.read()[-2000:]}"
+            )
+        if line.startswith(prefix):
+            return line.strip()
+    raise AssertionError(f"timed out waiting for {prefix!r}")
+
+
+def test_two_replica_federation_smoke(tmp_path):
+    """ISSUE CI satellite: spawn two engine processes, federate their
+    /snapshotz endpoints, golden-check the merged counters against the
+    children, and join one request's client+replica span segments under
+    a single trace id in the exported Chrome trace."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    tele_dir = tmp_path / "tele"
+    n_per_replica = 3
+    procs = []
+    try:
+        for _ in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tests", "_replica_worker.py"),
+                 str(tele_dir)],
+                env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            ))
+        deadline = time.monotonic() + 420  # CPU compile dominates
+        ports = [
+            int(_read_stdout_line(p, "PORT ", deadline).split()[1])
+            for p in procs
+        ]
+
+        # The parent is the CLIENT process: it mints the trace ids, logs
+        # its own client.request segments, and hands the ids across the
+        # process hop (stdin here; the fleet router's RPC tomorrow).
+        client_log = telemetry.JsonlWriter(str(tmp_path / "client"))
+        trace_ids = [
+            [telemetry.new_trace_id("client") for _ in range(n_per_replica)]
+            for _ in procs
+        ]
+        for p, ids in zip(procs, trace_ids):
+            for tid in ids:
+                t0 = time.monotonic()
+                p.stdin.write(tid + "\n")
+                p.stdin.flush()
+                client_log.write(telemetry.span_event(
+                    "client.request", tid,
+                    telemetry.spans_from_marks([
+                        ("issue", t0), ("client_submit", time.monotonic()),
+                    ]),
+                    attrs={"pid": os.getpid(), "role": "client"},
+                ))
+
+        # Federate while both replicas are live.
+        agg = FederatedAggregator(replicas={
+            f"r{i}": f"http://127.0.0.1:{port}"
+            for i, port in enumerate(ports)
+        })
+        # Children scrape their requests' completion asynchronously; poll
+        # (timeout-guarded) until the fleet-wide served counter converges.
+        want = 2 * n_per_replica
+        while time.monotonic() < deadline:
+            agg.scrape_once()
+            got = agg.registry.get("serve_requests_total")
+            if got is not None and got.value(outcome="served") == want:
+                break
+            time.sleep(0.2)
+
+        # Golden-check the merge against the children's own /snapshotz.
+        child_served = []
+        for port in ports:
+            snap = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/snapshotz", timeout=10
+            ).read())
+            telemetry.validate_event(snap)  # the schema federation trusts
+            child_served.append(sum(
+                s["value"]
+                for s in snap["metrics"]["serve_requests_total"]["series"]
+                if s["labels"]["outcome"] == "served"
+            ))
+        assert child_served == [n_per_replica, n_per_replica]
+        assert agg.registry.get("serve_requests_total").value(
+            outcome="served"
+        ) == sum(child_served)
+        # Per-replica-labeled gauges survived the merge.
+        depth = {
+            s["labels"]["replica"]: s["value"]
+            for s in agg.registry.get("serve_queue_depth").snapshot_series()
+        }
+        assert {"r0", "r1", "sum", "min", "max"} <= set(depth)
+        assert agg.registry.get("federation_replicas").value(state="up") == 2
+
+        for p in procs:
+            p.stdin.write("DONE\n")
+            p.stdin.close()
+        for p in procs:
+            assert "SERVED" in _read_stdout_line(p, "SERVED", deadline)
+            assert p.wait(timeout=60) == 0
+        client_log.close()
+
+        # One request's spans join across the process hop: client segment
+        # from THIS pid, engine lifecycle from the replica's pid, one id.
+        events = []
+        for d in (tmp_path / "client", tele_dir):
+            for f in os.listdir(d):
+                events.extend(telemetry.read_events(str(d / f)))
+        tid = trace_ids[0][0]
+        doc = telemetry.chrome_trace(events, trace_id=tid)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        pids = {e["pid"] for e in xs}
+        assert os.getpid() in pids and procs[0].pid in pids
+        names = {e["name"] for e in xs}
+        assert {"client_submit", "queue_wait", "batch_form",
+                "h2d_stage", "device_compute"} <= names
+        # Every replica's engine segment carries the propagated ids, and
+        # ids never collide across the two processes' own minting.
+        groups = telemetry.group_spans_by_trace(events)
+        for ids in trace_ids:
+            for t in ids:
+                assert t in groups
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()

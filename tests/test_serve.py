@@ -15,6 +15,7 @@ cross-bucket parity (bucket-1 vs bucket-4 executables) is checked to 1e-5.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -167,6 +168,29 @@ def test_engine_serves_correct_results(model):
     ) == 10
 
 
+def test_submit_propagates_caller_trace_id(model, tmp_path):
+    """Distributed-trace seam: a caller-minted trace id rides through the
+    engine's span events, and the resolved future reports the id plus
+    the engine-side e2e latency (the client-overhead input)."""
+    from mpi4dl_tpu import telemetry
+
+    eng = _engine(model, telemetry_dir=str(tmp_path))
+    eng.start()
+    try:
+        fut = eng.submit(_examples(1)[0], trace_id="hop-abc-7")
+        fut.result(timeout=60)
+    finally:
+        eng.stop()
+    assert fut.trace_id == "hop-abc-7"
+    assert fut.e2e_latency_s > 0
+    (log,) = tmp_path.iterdir()
+    (ev,) = [
+        e for e in telemetry.read_events(str(log)) if e["kind"] == "span"
+    ]
+    assert ev["trace_id"] == "hop-abc-7"
+    assert ev["attrs"]["pid"] == os.getpid()
+
+
 # -- deadlines + admission control -------------------------------------------
 
 
@@ -229,6 +253,44 @@ def test_every_bucket_precompiled_and_missing_bucket_fails_loudly(model):
     finally:
         eng._compiled[4] = missing
         eng.stop()
+
+
+def test_sampled_attribution_publishes_live_trace_gauges(model):
+    """ISSUE tentpole: with attribution_every on (interval floor lifted
+    for the test), the serving loop itself captures a batch, parses it,
+    and publishes the trace_* gauges under program=serve_sampled — the
+    continuous twin of the one-shot --trace-dir report. With the floor
+    at its default, the same traffic never samples (rate-limit works)."""
+    eng = _engine(
+        model, attribution_every=2, attribution_min_interval_s=0.0
+    )
+    eng.start()
+    try:
+        futs = [eng.submit(x) for x in _examples(10)]
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        eng.stop()
+    assert eng.last_attribution is not None
+    assert eng.last_attribution["program"] == "serve_sampled"
+    wall = eng.registry.get("trace_step_wall_seconds")
+    assert wall.value(program="serve_sampled") > 0
+    att = eng.registry.get("trace_attribution_seconds")
+    assert att.value(program="serve_sampled", category="compute") > 0
+    # Sampled batches still serve correct results (checked implicitly by
+    # result(); the futures resolved, none errored).
+
+    # Default 30 s floor: same config, no sample fires after the
+    # constructor's throwaway warm-up.
+    eng2 = _engine(model, attribution_every=2)
+    eng2.start()
+    try:
+        futs = [eng2.submit(x) for x in _examples(6)]
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        eng2.stop()
+    assert eng2.last_attribution is None
 
 
 # -- hlolint serving gate ----------------------------------------------------
@@ -382,9 +444,24 @@ def test_serve_cli_end_to_end(capsys, tmp_path):
     assert rep["slo"]["slos"]["availability"]["sli"] == 1.0
     assert rep["slo"]["slos"]["availability"]["budget_remaining"] == 1.0
     assert rep["slo"]["alerts_fired"] == {}
+    # Client-hop accounting (ISSUE satellite): the report carries the
+    # measured client-vs-engine latency gap, non-negative by definition.
+    assert rep["loadgen"]["client_overhead_s"]["p50"] >= 0
     (log,) = tmp_path.iterdir()
+    events = telemetry.read_events(str(log))
     served = [
-        e for e in telemetry.read_events(str(log))
-        if e["kind"] == "span" and e["attrs"]["outcome"] == "served"
+        e for e in events
+        if e["kind"] == "span" and e["name"] == "serve.request"
+        and e["attrs"]["outcome"] == "served"
     ]
     assert len(served) == 24
+    # Distributed-trace join (ISSUE tentpole): the in-process client's
+    # span segments share trace ids with the engine's — one id covers
+    # client_submit→client_wait AND queue→batch→device.
+    client = [
+        e for e in events
+        if e["kind"] == "span" and e["name"] == "client.request"
+    ]
+    assert {e["trace_id"] for e in client} >= {
+        e["trace_id"] for e in served
+    }

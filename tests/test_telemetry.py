@@ -359,6 +359,118 @@ def test_steptimer_zero_dt_summary_does_not_raise():
     assert s["images_per_sec_mean"] == 20.0
 
 
+def test_prometheus_escaping_round_trips():
+    """ISSUE satellite: HELP text and label values containing newlines,
+    quotes, and backslashes survive escape → render → unescape exactly —
+    including the sequences naive replace-chains corrupt (a literal
+    backslash before an 'n', a trailing backslash)."""
+    from mpi4dl_tpu.telemetry.export import (
+        escape_help,
+        escape_label_value,
+        unescape_help,
+        unescape_label_value,
+    )
+
+    nasty = [
+        'plain',
+        'a"b\\c\nd',
+        'line1\nline2\n',
+        'backslash-n literal \\n not newline',
+        'trailing backslash \\',
+        '\\\n"',
+        '\\\\n',  # two backslashes then n — must not become \ + newline
+    ]
+    for s in nasty:
+        assert unescape_label_value(escape_label_value(s)) == s, s
+        assert unescape_help(escape_help(s)) == s, s
+        # Escaped forms are single-line (the format's framing invariant).
+        assert "\n" not in escape_label_value(s)
+        assert "\n" not in escape_help(s)
+    # And through a full render: the escaped sample parses back to the
+    # original value from the exposition text itself.
+    reg = telemetry.MetricsRegistry()
+    reg.counter("rt_total", "h", labels=("path",)).inc(path='a"b\\c\nd')
+    text = telemetry.render_prometheus(reg)
+    (line,) = [l for l in text.splitlines() if l.startswith("rt_total{")]
+    quoted = line[line.index('path="') + len('path="'):line.rindex('"')]
+    assert unescape_label_value(quoted) == 'a"b\\c\nd'
+
+
+def test_trace_ids_unique_across_processes(tmp_path):
+    """ISSUE satellite: trace ids embed pid + a random component, so N
+    replica processes minting ids concurrently cannot collide in the
+    federated span stream — checked across two real spawned processes."""
+    import subprocess
+    import sys
+
+    prog = (
+        "from mpi4dl_tpu.telemetry import new_trace_id\n"
+        "print('\\n'.join(new_trace_id('serve') for _ in range(200)))\n"
+    )
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    outs = []
+    for _ in range(2):
+        outs.append(subprocess.run(
+            [sys.executable, "-c", prog], env=env,
+            capture_output=True, text=True, timeout=120, check=True,
+        ).stdout.split())
+    a, b = (set(o) for o in outs)
+    assert len(a) == len(b) == 200
+    assert not (a & b), "trace ids collided across processes"
+    # Format: prefix-pidhex-rand32-counter; in-process ids stay ordered.
+    assert outs[0][0].endswith("-0") and outs[0][199].endswith("-199")
+    assert len(outs[0][0].split("-")) == 4
+
+
+def test_metrics_server_snapshotz_is_machine_readable():
+    """Tentpole seam: /snapshotz serves the registry as a schema-valid
+    metrics event + the emitting pid — what the federation aggregator
+    scrapes instead of parsing Prometheus text."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("up_total").inc(4)
+    reg.histogram("lat", buckets=(0.1,)).observe(0.05)
+    srv = telemetry.MetricsServer(reg, port=0)
+    try:
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/snapshotz", timeout=10
+        ).read())
+        telemetry.validate_event(snap)
+        assert snap["kind"] == "metrics"
+        assert snap["pid"] == os.getpid()
+        assert snap["metrics"]["up_total"]["series"][0]["value"] == 4
+        index = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/", timeout=10
+        ).read().decode()
+        assert "/snapshotz" in index
+    finally:
+        srv.close()
+
+
+def test_client_overhead_and_phase_shares_published(full_stack):
+    """ISSUE satellites: the client-vs-engine latency gap is a real
+    histogram (one observation per served request), and the engine's
+    phase-share gauges mirror the span mix, summing to ~1."""
+    reg, _, report, _, scraped = full_stack
+    (ov,) = reg.get("serve_client_overhead_seconds").snapshot_series()
+    assert ov["count"] == 48
+    assert ov["sum"] >= 0
+    assert report["client_overhead_s"] is not None
+    assert report["client_overhead_s"]["p50"] >= 0
+    assert "serve_client_overhead_seconds_bucket" in scraped
+
+    shares = {
+        s["labels"]["phase"]: s["value"]
+        for s in reg.get("serve_phase_share").snapshot_series()
+    }
+    assert set(shares) == {
+        "queue_wait", "batch_form", "h2d_stage", "device_compute"
+    }
+    assert sum(shares.values()) == pytest.approx(1.0, abs=1e-6)
+
+
 # -- catalog gates: docs <-> catalog <-> what the stack exposes ---------------
 
 _DOC_ROW = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|([^|]+)\|([^|]+)\|")
@@ -448,10 +560,23 @@ def full_stack(tmp_path_factory):
         ),
     )
     engine.start()
-    report = run_closed_loop(engine, 48, concurrency=12, deadline_s=30.0)
+    report = run_closed_loop(
+        engine, 48, concurrency=12, deadline_s=30.0, events=engine.events,
+    )
     scraped = urllib.request.urlopen(
         f"http://127.0.0.1:{engine.metrics_port}/metrics", timeout=10
     ).read().decode()
+    # Federation publisher against the same registry: an aggregator
+    # scraping this engine's own /snapshotz (the catalog pin must see
+    # federation_replicas / federation_scrapes_total from a real scrape).
+    from mpi4dl_tpu.telemetry.federation import FederatedAggregator
+
+    agg = FederatedAggregator(
+        replicas={"r0": f"http://127.0.0.1:{engine.metrics_port}"},
+        registry=reg,
+    )
+    agg.scrape_once()
+    assert agg.registry.get("federation_replicas").value(state="up") == 1
     engine.stop()
     engine.lint_report()  # hlolint_* gauges
 
@@ -521,9 +646,21 @@ def test_span_durations_sum_to_e2e_latency(full_stack):
     sum to the observed end-to-end latency, per request, exactly — the
     spans are contiguous by construction."""
     events = full_stack[3]
-    span_events = [e for e in events if e["kind"] == "span"]
+    span_events = [
+        e for e in events
+        if e["kind"] == "span" and e["name"] == "serve.request"
+    ]
     served = [e for e in span_events if e["attrs"]["outcome"] == "served"]
     assert len(served) == 48
+    # The in-process client wrote its own span segments into the same
+    # log, sharing trace ids with the engine's — the joined view the
+    # trace exporter renders.
+    client = [
+        e for e in events
+        if e["kind"] == "span" and e["name"] == "client.request"
+    ]
+    assert len(client) == 48
+    assert {e["trace_id"] for e in client} == {e["trace_id"] for e in served}
     for e in served:
         phases = [s["phase"] for s in e["spans"]]
         assert phases == [
