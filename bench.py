@@ -408,6 +408,16 @@ def _measure_serving() -> dict:
         "rejected": rep["rejected_queue_full"],
         "lint_ok": lint.ok,
         "slo": engine.slo.verdict(),
+        # Footprint ledger (docs/OBSERVABILITY.md "Memory"): each warmed
+        # bucket's compile-time predicted peak, so BENCH_*.json records
+        # the serving memory trajectory next to the throughput one
+        # (bench-history trends it with an inverted regression sign).
+        "peak_hbm_bytes_by_bucket": {
+            str(b): e["peak_bytes"]
+            for b in engine.buckets
+            for e in [engine.memory_ledger.get("serve_predict", bucket=b)]
+            if e is not None and e.get("peak_bytes") is not None
+        },
     }
     # Phase mix + client-hop cost (docs/OBSERVABILITY.md "Federation &
     # distributed tracing"): the per-round trajectory of WHERE served
@@ -491,6 +501,13 @@ def _hlo_overlap_metrics() -> "dict | None":
             from mpi4dl_tpu.analysis.metrics import publish_report
 
             publish_report(rep, _REGISTRY)
+            # Footprint ledger: the already-compiled train step's peak
+            # under program_peak_hbm_bytes (zero extra compile).
+            from mpi4dl_tpu.telemetry.memory import FootprintLedger
+
+            FootprintLedger(registry=_REGISTRY).record_compiled(
+                "train_step", compiled
+            )
         # The static report is the "should overlap" side the measured
         # trace attribution cross-checks against (_trace_attribution).
         _LAST_RUN["lint_report"] = rep
@@ -844,12 +861,19 @@ def main():
                 "unit": "square image side, bs=1, one chip",
             }
 
-            def record(size, ips, note=None):
+            def record(size, ips, note=None, oom=None):
                 if size is not None:
                     entry["peak_trainable_px_per_chip"] = size
                     entry["img_per_sec_at_peak"] = ips
                 if note:
                     entry["stopped_by"] = note
+                if oom is not None:
+                    # Structured RESOURCE_EXHAUSTED parse (telemetry/
+                    # memory.py) next to the raw stopped_by string: the
+                    # wall's HBM table — used/limit/exceeded bytes and
+                    # the largest buffers — lands in BENCH_*.json
+                    # instead of dying in a truncated message.
+                    entry["oom"] = oom
                 extras["resnet_peak_pixels"] = entry
                 _RESULT["extras"] = extras
                 if _RESULT.get("metric"):
@@ -978,7 +1002,22 @@ def main():
                     )
                 except Exception as e:  # noqa: BLE001 — walk stops here
                     msg = f"{type(e).__name__}: {str(e)[:120]}"
-                    record(None, None, f"{size}: {msg}")
+                    oom = None
+                    from mpi4dl_tpu.telemetry import memory as memobs
+
+                    if memobs.is_oom_error(e):
+                        # OOM forensics: emit the schema-valid oom.report
+                        # (counter + env-gated JSONL) and embed the parse
+                        # in the result line, raw message kept alongside.
+                        ev = memobs.emit_oom_report(
+                            e, program=f"resnet110_{size}px_bs1_walk",
+                            registry=_REGISTRY, events=_TELEMETRY_LOG,
+                        )
+                        oom = {
+                            "parsed": ev["attrs"]["parsed"],
+                            "largest_buffer": ev["attrs"]["largest_buffer"],
+                        }
+                    record(None, None, f"{size}: {msg}", oom=oom)
                     # Classify on the UNTRUNCATED text of the whole
                     # exception chain: wrapped transport errors can carry
                     # their signature past any prefix or in a __cause__.

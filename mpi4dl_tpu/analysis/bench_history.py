@@ -70,11 +70,19 @@ def _load_round(path: str) -> dict:
 
 def extract_series(result: dict) -> "dict[str, float]":
     """Comparable numeric series of one parsed result line: the headline
-    throughput under its metric name, every extra's ``value``, and the
-    peak-pixels capability point."""
+    throughput under its metric name, every extra's ``value``, the
+    peak-pixels capability point, and the memory series — the headline
+    ``hlo`` block's peak HBM and the serving extra's per-bucket predicted
+    peaks (keys carrying ``peak_hbm_bytes`` are lower-is-better: the
+    regression verdict inverts for them)."""
     out: dict[str, float] = {}
     if result.get("metric") and isinstance(result.get("value"), (int, float)):
         out[result["metric"]] = float(result["value"])
+    hlo = result.get("hlo")
+    if isinstance(hlo, dict) and isinstance(
+        hlo.get("peak_hbm_bytes"), (int, float)
+    ):
+        out["hlo.peak_hbm_bytes"] = float(hlo["peak_hbm_bytes"])
     for name, entry in (result.get("extras") or {}).items():
         if not isinstance(entry, dict):
             continue
@@ -83,7 +91,19 @@ def extract_series(result: dict) -> "dict[str, float]":
         peak = entry.get("peak_trainable_px_per_chip")
         if isinstance(peak, (int, float)):
             out[f"{name}.peak_px"] = float(peak)
+        by_bucket = entry.get("peak_hbm_bytes_by_bucket")
+        if isinstance(by_bucket, dict):
+            for b, v in by_bucket.items():
+                if isinstance(v, (int, float)):
+                    out[f"{name}.peak_hbm_bytes[b{b}]"] = float(v)
     return out
+
+
+def lower_is_better(key: str) -> bool:
+    """Memory series regress UPWARD: a grown footprint is the failure,
+    a shrunk one the improvement — the inverse of every throughput/
+    capability series."""
+    return "peak_hbm_bytes" in key
 
 
 def compare(rounds: "list[dict]", tolerance: float, strict: bool) -> dict:
@@ -116,15 +136,26 @@ def compare(rounds: "list[dict]", tolerance: float, strict: bool) -> dict:
         prev = next(
             (v for v in reversed(vals[:latest]) if v is not None), None
         )
+        lo, hi = prev, prev
+        if prev is not None:
+            lo, hi = prev * (1 - tolerance), prev * (1 + tolerance)
         if cur is None:
             verdict = "gone" if prev is not None else "never"
             regressed = strict and prev is not None
         elif prev is None:
             verdict, regressed = "new", False
-        elif cur < prev * (1 - tolerance):
-            verdict, regressed = "regressed", True
-        elif cur > prev * (1 + tolerance):
-            verdict, regressed = "improved", False
+        elif cur < lo:
+            # Below the band: a throughput/capability drop is the
+            # regression; a memory-footprint drop is the improvement.
+            if lower_is_better(key):
+                verdict, regressed = "improved", False
+            else:
+                verdict, regressed = "regressed", True
+        elif cur > hi:
+            if lower_is_better(key):
+                verdict, regressed = "regressed", True
+            else:
+                verdict, regressed = "improved", False
         else:
             verdict, regressed = "flat", False
         n_regressed += bool(regressed)

@@ -14,14 +14,18 @@ Examples::
     python -m mpi4dl_tpu.analyze --model amoebanet --size 64 --dp 2
     python -m mpi4dl_tpu.analyze --model resnet --size 512 --write-baseline
 
-Two subcommands: ``python -m mpi4dl_tpu.analyze bench-history
+Three subcommands: ``python -m mpi4dl_tpu.analyze bench-history
 BENCH_r*.json`` compares the committed bench rounds and fails on a
 throughput regression (:mod:`mpi4dl_tpu.analysis.bench_history`);
 ``python -m mpi4dl_tpu.analyze trace-export LOG... [--trace-id ID]``
 joins span segments from N processes' JSONL telemetry logs by trace id
 and writes one Chrome trace — a request's full client → queue → batch →
 device lifetime across process boundaries
-(:func:`mpi4dl_tpu.telemetry.federation.trace_export_main`).
+(:func:`mpi4dl_tpu.telemetry.federation.trace_export_main`);
+``python -m mpi4dl_tpu.analyze memory-plan`` predicts peak HBM vs the
+device limit for a requested config — compile-only, nothing executes —
+and bisects the max feasible px/bucket
+(:mod:`mpi4dl_tpu.analysis.memory_plan`).
 """
 
 from __future__ import annotations
@@ -153,6 +157,14 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.telemetry.federation import trace_export_main
 
         return trace_export_main(argv[1:])
+    if argv and argv[0] == "memory-plan":
+        # Feasibility planner. Its artifact mode (committed peaks vs a
+        # limit) is pure JSON and must dispatch before any backend
+        # setup, like bench-history; its compile mode sets up jax
+        # itself only when asked to lower a config.
+        from mpi4dl_tpu.analysis.memory_plan import main as memory_plan
+
+        return memory_plan(argv[1:])
     args = build_parser().parse_args(argv)
 
     from mpi4dl_tpu.utils import apply_platform_env, enable_compilation_cache

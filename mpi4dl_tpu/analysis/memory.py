@@ -54,6 +54,49 @@ def memory_summary(compiled) -> dict | None:
     return out
 
 
+def feasibility(
+    peak_bytes: "int | None", limit_bytes: "int | None",
+    fit_margin: float = 0.0,
+) -> dict:
+    """Verdict for one predicted peak against a device limit: does the
+    program fit, and with how much headroom. ``fit_margin`` reserves a
+    fraction of the limit (0.05 = demand 5% free after the program);
+    with either side unknown the verdict is ``fits=None``, never a
+    fabricated yes/no."""
+    out = {
+        "peak_bytes": None if peak_bytes is None else int(peak_bytes),
+        "limit_bytes": None if limit_bytes is None else int(limit_bytes),
+        "fits": None,
+        "headroom_bytes": None,
+        "headroom_ratio": None,
+    }
+    if peak_bytes is None or not limit_bytes:
+        return out
+    headroom = int(limit_bytes) - int(peak_bytes)
+    out["headroom_bytes"] = headroom
+    out["headroom_ratio"] = headroom / int(limit_bytes)
+    out["fits"] = bool(out["headroom_ratio"] >= float(fit_margin))
+    return out
+
+
+def load_baseline_all(path: str | None = None) -> dict:
+    """Every committed peak: ``{key: peak_bytes}`` (the planner's
+    artifact mode reads the whole table, not one key)."""
+    path = path or DEFAULT_BASELINE_PATH
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except Exception:  # noqa: BLE001 — absent/corrupt = empty
+        return {}
+    out = {}
+    for key, ent in data.items():
+        if isinstance(ent, dict):
+            ent = ent.get("peak_bytes")
+        if ent is not None:
+            out[key] = int(ent)
+    return out
+
+
 def load_baseline(key: str, path: str | None = None) -> int | None:
     path = path or DEFAULT_BASELINE_PATH
     try:

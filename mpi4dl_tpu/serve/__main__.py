@@ -110,6 +110,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="floor between attribution samples, seconds — "
                         "caps the amortized sampling overhead at "
                         "~capture cost / interval regardless of rps")
+    p.add_argument("--memory-guard", action="store_true",
+                   help="refuse to warm any bucket whose footprint-"
+                        "ledger predicted peak exceeds the device limit "
+                        "(or whose compile OOMs) instead of crashing — "
+                        "serving degrades to the buckets that fit")
+    p.add_argument("--memory-limit-bytes", type=int, default=None,
+                   help="device-capacity override for the memory guard "
+                        "(default: the device's memory_stats() limit)")
+    p.add_argument("--no-memory-monitor", action="store_true",
+                   help="disable the live device_hbm_* gauge sampler")
     p.add_argument("--json", dest="json_out", default=None,
                    help="also write the report JSON here")
     return p
@@ -156,6 +166,9 @@ def _liveness_kw(args) -> dict:
         "slo": _slo_config(args),
         "attribution_every": args.attribution_every,
         "attribution_min_interval_s": args.attribution_min_interval,
+        "memory_guard": args.memory_guard,
+        "memory_limit_bytes": args.memory_limit_bytes,
+        "memory_monitor": not args.no_memory_monitor,
     }
 
 

@@ -149,6 +149,21 @@ class ServingEngine:
         no matter the request rate. The profiler backend's one-time
         ~3 s init is paid at construction (with a throwaway capture),
         never by a live request.
+    memory_monitor: sample ``jax.Device.memory_stats()`` into the
+        ``device_hbm_*`` gauges at the SLO-evaluator cadence
+        (:class:`telemetry.MemoryMonitor`; docs/OBSERVABILITY.md
+        "Memory"). Backends without stats (CPU) publish nothing and the
+        sampler retires itself — absent-not-wrong.
+    memory_guard: opt-in admission guard: a bucket whose footprint-
+        ledger predicted peak exceeds the device limit — or whose
+        compile dies on RESOURCE_EXHAUSTED — is refused at warm-up
+        (recorded in :attr:`refused_buckets` / ``stats()["memory"]``)
+        instead of crashing the engine; serving degrades to the buckets
+        that fit.
+    memory_limit_bytes: explicit device-capacity override for the guard
+        and ``stats()["memory"]``; None reads the device's
+        ``memory_stats()`` limit (absent on CPU → the guard's peak
+        check is skipped, compile-OOM refusal still applies).
     """
 
     def __init__(
@@ -173,11 +188,15 @@ class ServingEngine:
         slo=None,
         attribution_every: "int | None" = None,
         attribution_min_interval_s: float = 30.0,
+        memory_monitor: bool = True,
+        memory_guard: bool = False,
+        memory_limit_bytes: "int | None" = None,
     ):
         import jax
         import jax.numpy as jnp
 
         from mpi4dl_tpu.evaluate import aot_compile_predict
+        from mpi4dl_tpu.telemetry import memory as memobs
 
         dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
         self._np_dtype = np.dtype(dtype.name)
@@ -187,7 +206,6 @@ class ServingEngine:
             if buckets is not None
             else power_of_two_buckets(max_batch)
         )
-        self._max_batch = max(self._buckets)
         self._max_wait_s = float(max_wait_s)
         self._default_deadline_s = float(default_deadline_s)
         self._device = jax.devices()[0]
@@ -196,13 +214,84 @@ class ServingEngine:
         self._params = jax.device_put(params, self._device)
         self._stats = jax.device_put(batch_stats, self._device)
 
+        # The registry (and the memory machinery reading/writing it)
+        # exists BEFORE warm-up: the footprint ledger records each
+        # bucket's predicted peak at compile time, and the admission
+        # guard consults it before anything executes.
+        self.registry = (
+            registry if registry is not None else telemetry.MetricsRegistry()
+        )
+        self._events = telemetry.JsonlWriter(telemetry_dir)
+        self.memory_ledger = memobs.FootprintLedger(registry=self.registry)
+        self.memory_monitor: "memobs.MemoryMonitor | None" = (
+            memobs.MemoryMonitor(
+                self.registry,
+                interval_s=(
+                    slo.interval_s
+                    if slo is not None and getattr(slo, "interval_s", None)
+                    else 1.0
+                ),
+            )
+            if memory_monitor
+            else None
+        )
+        self._memory_limit = (
+            int(memory_limit_bytes)
+            if memory_limit_bytes is not None
+            else memobs.device_memory_limit(self._device)
+        )
+        self.refused_buckets: "dict[int, dict]" = {}
+        telemetry.declare(self.registry, "oom_reports_total")
+
         # AOT warm-up: compile every bucket now, then run each once so the
         # first real request pays neither a compile nor a first-exec setup.
-        self._compiled = aot_compile_predict(
-            cells, self._params, self._stats, self.example_shape,
-            self._buckets, dtype=dtype,
-        )
+        # With the opt-in admission guard, a bucket whose predicted peak
+        # (footprint ledger, known at compile time) exceeds the device
+        # limit — or whose compile itself dies on RESOURCE_EXHAUSTED —
+        # is REFUSED instead of crashing the engine: graceful degradation
+        # to the buckets that fit.
+        self._compiled = {}
         self.warm_latency_s: dict[int, float] = {}
+        for b in self._buckets:
+            try:
+                compiled = aot_compile_predict(
+                    cells, self._params, self._stats, self.example_shape,
+                    [b], dtype=dtype,
+                )[b]
+            except Exception as e:  # noqa: BLE001 — compile-time OOM is a
+                # memory fact about the bucket, not an engine defect
+                if memory_guard and memobs.is_oom_error(e):
+                    self._refuse_bucket(b, "compile_oom", error=e)
+                    continue
+                memobs.emit_oom_report(
+                    e, program="serve_predict", bucket=b,
+                    registry=self.registry, events=self._events,
+                )
+                raise
+            entry = self.memory_ledger.record_compiled(
+                "serve_predict", compiled, bucket=b
+            )
+            peak = entry.get("peak_bytes")
+            if (
+                memory_guard
+                and self._memory_limit is not None
+                and peak is not None
+                and peak > self._memory_limit
+            ):
+                self._refuse_bucket(
+                    b, "predicted_peak_exceeds_limit",
+                    peak_bytes=peak, limit_bytes=self._memory_limit,
+                )
+                continue
+            self._compiled[b] = compiled
+        if not self._compiled:
+            raise RuntimeError(
+                f"no serving bucket fits: every configured bucket "
+                f"{list(self._buckets)} was refused "
+                f"({ {b: r['reason'] for b, r in self.refused_buckets.items()} })"
+            )
+        self._buckets = tuple(sorted(self._compiled))
+        self._max_batch = max(self._buckets)
         for b in self._buckets:
             z = np.zeros((b, *self.example_shape), self._np_dtype)
             t0 = time.perf_counter()
@@ -231,10 +320,8 @@ class ServingEngine:
         self._batch_seq = 0
 
         # -- telemetry surface (docs/OBSERVABILITY.md) ----------------------
-        self.registry = (
-            registry if registry is not None else telemetry.MetricsRegistry()
-        )
-        self._events = telemetry.JsonlWriter(telemetry_dir)
+        # (registry + event writer already exist — created before warm-up
+        # so the memory machinery could use them.)
         decl = lambda name: telemetry.declare(self.registry, name)  # noqa: E731
         self._m_submitted = decl("serve_submitted_total")
         self._m_requests = decl("serve_requests_total")
@@ -291,7 +378,10 @@ class ServingEngine:
         self.slo: "telemetry.SLOEvaluator | None" = None
         if slo is not None:
             objectives = slo.objectives()
-            if objectives:
+            # The evaluator also runs for a headroom-only config (no
+            # availability/latency objective): the memory_headroom_low
+            # alert rides the same tick.
+            if objectives or getattr(slo, "headroom_alert_ratio", None) is not None:
                 autoscaler = telemetry.Autoscaler(
                     registry=self.registry,
                     config=slo.autoscale,
@@ -354,6 +444,22 @@ class ServingEngine:
         through THIS handle rather than opening the same file twice."""
         return self._events
 
+    def _refuse_bucket(self, bucket: int, reason: str, error=None, **facts):
+        """Admission-guard refusal: record why the bucket will not be
+        warmed (stats()/debugz surface it) instead of letting the first
+        execution crash the process. A compile-time OOM additionally
+        emits the structured ``oom.report``."""
+        from mpi4dl_tpu.telemetry import memory as memobs
+
+        entry = {"reason": reason, **facts}
+        if error is not None:
+            ev = memobs.emit_oom_report(
+                error, program="serve_predict", bucket=bucket,
+                registry=self.registry, events=self._events,
+            )
+            entry["oom"] = ev["attrs"]["parsed"]
+        self.refused_buckets[int(bucket)] = entry
+
     def assert_warm(self) -> None:
         """Every configured bucket must have its pre-built executable —
         the no-compile-after-warm-up contract."""
@@ -369,6 +475,8 @@ class ServingEngine:
             return
         self._stop_evt.clear()
         self._record_marker("serve.start")
+        if self.memory_monitor is not None:
+            self.memory_monitor.start()
         if self.slo is not None:
             self.slo.start()
         self._thread = threading.Thread(
@@ -392,6 +500,8 @@ class ServingEngine:
         # stays dumpable.
         if self.watchdog is not None:
             self.watchdog.close()
+        if self.memory_monitor is not None:
+            self.memory_monitor.close()
         if self.slo is not None:
             # Final evaluation so the last requests' outcomes reach the
             # gauges/verdict before the evaluator thread stops.
@@ -493,7 +603,30 @@ class ServingEngine:
         out["buckets"] = list(self._buckets)
         out["warm_latency_s"] = dict(self.warm_latency_s)
         out["healthy"] = self.health.healthy
+        out["memory"] = self.memory_view()
         return out
+
+    def memory_view(self) -> dict:
+        """The memory observability surface (stats()/debugz): per-bucket
+        predicted peaks from the footprint ledger, refused buckets, the
+        configured/device limit, and the latest live device sample."""
+        buckets = {}
+        for b in self._buckets:
+            e = self.memory_ledger.get("serve_predict", bucket=b)
+            if e is not None:
+                buckets[str(b)] = e.get("peak_bytes")
+        return {
+            "bucket_peak_hbm_bytes": buckets,
+            "refused_buckets": {
+                str(b): dict(v) for b, v in self.refused_buckets.items()
+            },
+            "limit_bytes": self._memory_limit,
+            "devices": (
+                self.memory_monitor.state()
+                if self.memory_monitor is not None else None
+            ),
+            "programs": self.memory_ledger.summary()["entries"],
+        }
 
     # -- liveness + postmortem -----------------------------------------------
 
@@ -575,6 +708,16 @@ class ServingEngine:
             # requests, flip health, fail what's queued, then surface.
             self.health.set_unhealthy(f"batcher crashed: {e!r}")
             self._record_marker("serve.crash", error=repr(e))
+            from mpi4dl_tpu.telemetry import memory as memobs
+
+            if memobs.is_oom_error(e):
+                # Structured forensics BEFORE the crash dump, so the
+                # oom.report sits in the ring the dump writes out.
+                memobs.emit_oom_report(
+                    e, program="serve_predict",
+                    registry=self.registry, events=self._events,
+                    flight=self.flight,
+                )
             try:
                 self.flight.dump(reason="crash")
             except Exception:  # noqa: BLE001 — postmortem best-effort
@@ -596,6 +739,19 @@ class ServingEngine:
                     self._record_marker(
                         "serve.batch_error", error=repr(e), batch=len(reqs)
                     )
+                    from mpi4dl_tpu.telemetry import memory as memobs
+
+                    if memobs.is_oom_error(e):
+                        # Runtime OOM on a live batch: structured report
+                        # into the event log + flight ring, and dump the
+                        # ring — the postmortem names the program, the
+                        # bucket, and the largest buffers.
+                        memobs.emit_oom_report(
+                            e, program="serve_predict",
+                            bucket=bucket_for(len(reqs), self._buckets),
+                            registry=self.registry, events=self._events,
+                            flight=self.flight, dump=True,
+                        )
                     for r in reqs:
                         r.future.set_exception(e)
                         if self.watchdog is not None:

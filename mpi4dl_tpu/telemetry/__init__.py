@@ -76,6 +76,15 @@ from mpi4dl_tpu.telemetry.health import (  # noqa: F401
     HealthState,
     Watchdog,
 )
+from mpi4dl_tpu.telemetry.memory import (  # noqa: F401
+    FootprintLedger,
+    MemoryMonitor,
+    device_memory_limit,
+    device_memory_stats,
+    emit_oom_report,
+    is_oom_error,
+    parse_resource_exhausted,
+)
 from mpi4dl_tpu.telemetry.jsonl import (  # noqa: F401
     ENV_DIR,
     JsonlWriter,

@@ -187,6 +187,18 @@ class SLOEvaluator:
                     name, bw.severity, for_s=config.for_s
                 )
                 self._m_active.set(0.0, alert=name, severity=bw.severity)
+        # Opt-in resource alert (telemetry/memory.py): pages when any
+        # device's live HBM headroom gauge drops under the configured
+        # fraction. Rides the same AlertState/transition/alert_active
+        # machinery as the burn alerts — one /alertz, one runbook shape.
+        self._headroom_ratio = getattr(config, "headroom_alert_ratio", None)
+        if self._headroom_ratio is not None:
+            self._headroom_ratio = float(self._headroom_ratio)
+            st = AlertState(
+                "memory_headroom_low", "page", for_s=config.for_s
+            )
+            self.alerts[st.name] = st
+            self._m_active.set(0.0, alert=st.name, severity=st.severity)
         self.transitions: collections.deque = collections.deque(maxlen=256)
         self.last_phase_attribution: "dict | None" = None
         self._last_burns: dict = {}
@@ -264,11 +276,53 @@ class SLOEvaluator:
                     self._emit_transition(
                         st, moved, obj, bw, b_long, b_short
                     )
+        if self._headroom_ratio is not None:
+            self._evaluate_headroom(now)
         with self._lock:
             self._last_burns = dict(burns)
         if self.autoscaler is not None:
             self.autoscaler.update(now, self.window, page_burn)
         return burns
+
+    def _evaluate_headroom(self, now: float) -> None:
+        """Step the ``memory_headroom_low`` machine from the live
+        per-device headroom gauges. No gauge series (CPU backend, or the
+        monitor not yet sampled) means the condition is NOT met — no
+        data must never page."""
+        st = self.alerts["memory_headroom_low"]
+        metric = "device_hbm_headroom_ratio"
+        low_dev, low = None, None
+        for dev in self.window.label_values(metric, "device"):
+            v = self.window.value(metric, device=dev)
+            if v is not None and (low is None or v < low):
+                low_dev, low = dev, v
+        active = low is not None and low < self._headroom_ratio
+        moved = st.step(active, now)
+        self._m_active.set(
+            1.0 if st.state == "firing" else 0.0,
+            alert=st.name, severity=st.severity,
+        )
+        if moved is not None:
+            old, new = moved
+            ev = {
+                "ts": time.time(),
+                "kind": "event",
+                "name": "alert.transition",
+                "attrs": {
+                    "alert": st.name,
+                    "severity": st.severity,
+                    "from": old,
+                    "to": new,
+                    "threshold": self._headroom_ratio,
+                    "headroom_min": low,
+                    "device": low_dev,
+                },
+            }
+            self.transitions.append(ev)
+            if self._flight is not None:
+                self._flight.record(ev)
+            if self._events is not None:
+                self._events.write(ev)
 
     def _emit_transition(self, st, moved, obj, bw, b_long, b_short) -> None:
         old, new = moved

@@ -98,6 +98,40 @@ CATALOG: "dict[str, MetricSpec]" = {
         "1 while the engine's health state is OK, 0 after a watchdog "
         "trip or batcher crash — the scrapeable twin of /healthz.",
     ),
+    # -- memory observability (mpi4dl_tpu/telemetry/memory.py) ---------------
+    "device_hbm_used_bytes": MetricSpec(
+        "gauge", ("device",),
+        "Live device memory in use, sampled from jax.Device."
+        "memory_stats() at the monitor cadence; absent (no series, not "
+        "zero) on backends that report no stats (CPU).",
+    ),
+    "device_hbm_limit_bytes": MetricSpec(
+        "gauge", ("device",),
+        "Device memory capacity from memory_stats(); absent on backends "
+        "that report no stats.",
+    ),
+    "device_hbm_headroom_ratio": MetricSpec(
+        "gauge", ("device",),
+        "(limit - used) / limit per device — the memory_headroom_low "
+        "alert's input; absent without a reported limit.",
+    ),
+    "serve_bucket_peak_hbm_bytes": MetricSpec(
+        "gauge", ("bucket",),
+        "Footprint-ledger predicted peak (buffer-assignment argument + "
+        "output + temp - alias) of each warmed serving bucket's compiled "
+        "executable, recorded at AOT warm-up before first execution.",
+    ),
+    "program_peak_hbm_bytes": MetricSpec(
+        "gauge", ("program",),
+        "Footprint-ledger predicted peak of a non-bucket compiled "
+        "program (train_step, eval) — the compile-time twin of the "
+        "hlolint peak gauge.",
+    ),
+    "oom_reports_total": MetricSpec(
+        "counter", ("program",),
+        "Structured RESOURCE_EXHAUSTED forensics (oom.report events) "
+        "emitted, by program.",
+    ),
     # -- liveness + postmortem (mpi4dl_tpu/telemetry/health.py, flight.py) ---
     "watchdog_trips_total": MetricSpec(
         "counter", (),

@@ -220,6 +220,13 @@ class SLOConfig:
         An explicit value that can't cover the longest window raises.
     autoscale: advisory autoscale policy knobs; None = defaults
         (:class:`mpi4dl_tpu.telemetry.autoscale.AutoscaleConfig`).
+    headroom_alert_ratio: opt-in ``memory_headroom_low`` page: fires
+        when any device's ``device_hbm_headroom_ratio`` gauge (the
+        :class:`~mpi4dl_tpu.telemetry.memory.MemoryMonitor` publishes
+        it) drops below this fraction (e.g. 0.05 = under 5% HBM free).
+        None disables; backends without memory stats never publish the
+        gauge, so the alert structurally cannot trip there
+        (absent-not-wrong).
     """
 
     availability: "float | None" = None
@@ -230,6 +237,7 @@ class SLOConfig:
     interval_s: float = 1.0
     window_capacity: "int | None" = None
     autoscale: "object | None" = None
+    headroom_alert_ratio: "float | None" = None
 
     def _longest_window_s(self) -> float:
         return max((bw.long_s for bw in self.burn_windows), default=0.0)

@@ -1093,7 +1093,51 @@ class Trainer:
 
                 stack.enter_context(pool_pallas.disable())
                 stack.enter_context(dot1x1_pallas.disable())
-            return call_with_halo_hint(self._jit_step, state, x, y)
+            try:
+                return call_with_halo_hint(self._jit_step, state, x, y)
+            except Exception as e:
+                # OOM forensics (telemetry/memory.py): a RESOURCE_EXHAUSTED
+                # train step emits a structured oom.report — the parsed HBM
+                # table + largest buffers — into the env-gated JSONL log
+                # before the exception surfaces. Three rounds of PERF.md
+                # debugging were spent re-discovering what the truncated
+                # message already carried; the report keeps it.
+                from mpi4dl_tpu.telemetry import memory as memobs
+
+                if memobs.is_oom_error(e):
+                    from mpi4dl_tpu import telemetry
+
+                    events = telemetry.JsonlWriter()  # env-gated; no-op
+                    try:  # without MPI4DL_TPU_TELEMETRY_DIR
+                        memobs.emit_oom_report(
+                            e, program="train_step",
+                            events=events if events.enabled else None,
+                            attrs={
+                                "image_size": self.config.image_size,
+                                "remat": self.remat
+                                if isinstance(self.remat, str)
+                                else str(self.remat),
+                            },
+                        )
+                    finally:
+                        events.close()
+                raise
+
+    def record_memory_footprint(
+        self, state, x, y, ledger=None, registry=None,
+        program: str = "train_step",
+    ) -> dict:
+        """Record the compiled train step's predicted peak into a
+        :class:`~mpi4dl_tpu.telemetry.memory.FootprintLedger` (a fresh
+        one when none is given). ``lower().compile()`` is a warm-cache
+        no-op for a step the process already traced, so calling this
+        after training costs no extra compile; before any execution it
+        is the feasibility planner's compile-only prediction."""
+        from mpi4dl_tpu.telemetry.memory import FootprintLedger
+
+        if ledger is None:
+            ledger = FootprintLedger(registry=registry)
+        return ledger.record_lowered(program, self._jit_step, state, x, y)
 
 
 def call_with_halo_hint(fn, *args):

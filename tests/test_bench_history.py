@@ -61,6 +61,66 @@ def test_extract_series_covers_headline_extras_and_peak():
     assert extract_series({"metric": "m", "value": None}) == {}
 
 
+def test_extract_series_memory_keys():
+    """ISSUE satellite: the headline ``hlo`` block's peak and the
+    serving extra's per-bucket predicted peaks become trend series."""
+    r = _result(7.0, 0.5)
+    r["hlo"] = {"peak_hbm_bytes": 17e9, "inventory": {}}
+    r["extras"]["serving_amoebanet3_32px"] = {
+        "value": 2000.0,
+        "peak_hbm_bytes_by_bucket": {"1": 2.0e6, "32": 2.7e6},
+    }
+    s = extract_series(r)
+    assert s["hlo.peak_hbm_bytes"] == 17e9
+    assert s["serving_amoebanet3_32px"] == 2000.0
+    assert s["serving_amoebanet3_32px.peak_hbm_bytes[b1]"] == 2.0e6
+    assert s["serving_amoebanet3_32px.peak_hbm_bytes[b32]"] == 2.7e6
+
+
+def test_peak_hbm_series_regresses_on_growth(tmp_path):
+    """ISSUE satellite: memory series get the SAME verdict treatment as
+    throughput — tolerance band, compare against the last round that
+    measured — but with the sign inverted: a grown footprint regresses
+    (CI exit 1), a shrunk one improves."""
+    grown, shrunk = _result(7.0, 0.5), _result(7.0, 0.5)
+    base = _result(7.0, 0.5)
+    base["hlo"] = {"peak_hbm_bytes": 10e9}
+    grown["hlo"] = {"peak_hbm_bytes": 12e9}     # +20% footprint
+    shrunk["hlo"] = {"peak_hbm_bytes": 8e9}     # -20% footprint
+    paths = _write_rounds(tmp_path, [_round(1, 0, base),
+                                     _round(2, 0, grown)])
+    assert main(paths) == 1  # growth is the regression
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [base, grown]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["hlo.peak_hbm_bytes"]["verdict"] == "regressed"
+    # Throughput keys keep the normal direction in the same run.
+    assert by_key["amoebanetd_1024px_bs2_train_tpu"]["verdict"] == "flat"
+
+    cmp = compare(
+        [{"path": "a", "n": 1, "rc": 0, "result": base},
+         {"path": "b", "n": 2, "rc": 0, "result": shrunk}],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["hlo.peak_hbm_bytes"]["verdict"] == "improved"
+    assert cmp["ok"] is True
+    # Inside the band: flat, either direction.
+    near = _result(7.0, 0.5)
+    near["hlo"] = {"peak_hbm_bytes": 10.2e9}
+    cmp = compare(
+        [{"path": "a", "n": 1, "rc": 0, "result": base},
+         {"path": "b", "n": 2, "rc": 0, "result": near}],
+        tolerance=0.05, strict=False,
+    )
+    assert {k["key"]: k for k in cmp["keys"]}[
+        "hlo.peak_hbm_bytes"
+    ]["verdict"] == "flat"
+
+
 def test_trend_improvement_exits_zero(tmp_path, capsys):
     paths = _write_rounds(tmp_path, [
         _round(1, 1, None),                      # failed round: no data

@@ -178,6 +178,32 @@ def test_spatial_eval_matches_plain_twin():
     np.testing.assert_allclose(got["loss"], golden["loss"], rtol=1e-5)
 
 
+def test_spatial_eval_footprint_recorded():
+    """Memory observability (docs/OBSERVABILITY.md "Memory"): the sharded
+    eval step's predicted peak lands in the footprint ledger through the
+    generic record_lowered hook — compile-only, nothing executes, and the
+    per-device number is what the tiled-inference sizing math reads."""
+    from mpi4dl_tpu import telemetry
+    from mpi4dl_tpu.evaluate import make_spatial_eval_step
+
+    trainer, plain = _spatial_trainer()
+    x0 = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    params = init_cells(plain, jax.random.PRNGKey(3), x0)
+    stats = collect_batch_stats(plain, params, _batches(1, (4, 32, 32, 3)))
+    xs, ys = trainer.shard_batch(x0, jnp.zeros((4,), jnp.int32))
+
+    reg = telemetry.MetricsRegistry()
+    ledger = telemetry.FootprintLedger(registry=reg)
+    entry = ledger.record_lowered(
+        "spatial_eval", make_spatial_eval_step(trainer),
+        params, stats, xs, ys,
+    )
+    assert entry["peak_bytes"] > 0
+    assert reg.get("program_peak_hbm_bytes").value(
+        program="spatial_eval"
+    ) == entry["peak_bytes"]
+
+
 def test_spatial_eval_scales_past_single_device_footprint():
     """The point of the sharded path: per-device activations are the train
     step's forward tiles — 1/num_tiles of the full image. Runs a config

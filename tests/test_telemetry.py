@@ -554,9 +554,12 @@ def full_stack(tmp_path_factory):
         telemetry_dir=tdir,
         # SLOs on (ISSUE CI satellite): the run must expose the slo_* /
         # alert_active / autoscale_desired_replicas names the catalog
-        # now pins.
+        # now pins. headroom_alert_ratio arms memory_headroom_low — on
+        # the CPU backend the gauge never publishes, so the alert is
+        # armed but structurally untrippable (absent-not-wrong).
         slo=telemetry.SLOConfig(
             availability=0.999, latency_threshold_s=2.5, interval_s=0.2,
+            headroom_alert_ratio=0.05,
         ),
     )
     engine.start()
@@ -594,6 +597,20 @@ def full_stack(tmp_path_factory):
     trainer.publish_telemetry(
         reg, params=params, x_shape=(2, size, size, 3)
     )
+    # Footprint ledger, train side: the compiled step's predicted peak
+    # under program_peak_hbm_bytes (the serve side recorded its buckets
+    # at AOT warm-up above).
+    state = trainer.init(jax.random.PRNGKey(0), (2, size, size, 3))
+    xs, ys = trainer.shard_batch(
+        jnp.zeros((2, size, size, 3), jnp.float32),
+        jnp.zeros((2,), jnp.int32),
+    )
+    trainer.record_memory_footprint(state, xs, ys, registry=reg)
+    # OOM forensics publisher: one canned-drill report so the counter
+    # carries a real series in the full-stack run.
+    from test_memory_obs import HBM_OOM
+
+    telemetry.emit_oom_report(HBM_OOM, program="drill", registry=reg)
 
     # Trace-attribution publisher (profiling.capture -> analysis.trace):
     # a ppermute ring on the CPU mesh so the capture carries collective
@@ -700,6 +717,34 @@ def test_scraped_endpoint_carries_serving_signals(full_stack):
     occupancy = reg.get("serve_batch_occupancy").snapshot_series()
     assert sum(x["count"] for x in occupancy) == s["batches"]
     assert sum(s["bucket_dispatches"].values()) == s["batches"]
+
+
+def test_memory_observability_exposed(full_stack):
+    """ISSUE acceptance: the full-stack run exposes every new memory
+    metric name (the catalog pin above covers exactness): per-bucket
+    ledger peaks with real values, the train step's program peak, the
+    drill's oom report count — and the device gauges declared but
+    series-less on the CPU backend (absent-not-wrong)."""
+    reg, engine = full_stack[0], full_stack[1]
+    bucket_peaks = reg.get("serve_bucket_peak_hbm_bytes")
+    for b in engine.buckets:
+        assert bucket_peaks.value(bucket=b) > 0
+        assert bucket_peaks.value(bucket=b) == engine.memory_ledger.get(
+            "serve_predict", bucket=b
+        )["peak_bytes"]
+    assert reg.get("program_peak_hbm_bytes").value(program="train_step") > 0
+    assert reg.get("oom_reports_total").value(program="drill") == 1
+    for name in ("device_hbm_used_bytes", "device_hbm_limit_bytes",
+                 "device_hbm_headroom_ratio"):
+        assert reg.get(name).snapshot_series() == []  # declared, absent
+    # The engine's stats()/debugz memory view mirrors the ledger.
+    mem = engine.stats()["memory"]
+    assert set(mem["bucket_peak_hbm_bytes"]) == {
+        str(b) for b in engine.buckets
+    }
+    # memory_headroom_low is armed on /alertz but untrippable on CPU.
+    alerts = {a["name"]: a["state"] for a in engine.slo.state()["alerts"]}
+    assert alerts["memory_headroom_low"] == "inactive"
 
 
 def test_trainer_and_hlolint_gauges_published(full_stack):
