@@ -441,6 +441,90 @@ def _measure_serving() -> dict:
     return entry
 
 
+def _measure_fleet() -> dict:
+    """Fleet recovery extra (docs/FLEET.md): 2 replica subprocesses
+    behind the router under closed-loop load, ``kill -9`` one mid-run —
+    records throughput through the fault, the requeue count, and the
+    death-to-replacement recovery time (bench-history trends
+    ``fleet_2replica.recovery_s`` with the regression sign inverted).
+    The workers are pinned to the CPU backend: this bench process owns
+    the accelerator, and the mechanics under measurement — dispatch,
+    requeue, respawn — are host-side."""
+    import signal as _signal
+    import threading
+
+    from mpi4dl_tpu.fleet.router import Router
+    from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    n_requests = 600
+    router = Router(
+        example_shape=(16, 16, 3), max_attempts=4,
+        inflight_per_replica=4, health_interval_s=0.1,
+        registry=_REGISTRY,
+    )
+    sup = FleetSupervisor(
+        ["--image-size", "16", "--max-batch", "2"],
+        router=router, replicas=2, max_replicas=2, env=env,
+        reconcile_interval_s=0.1, backoff_base_s=0.1,
+        backoff_max_s=0.5, spawn_timeout_s=420.0,
+    )
+    try:
+        t0 = time.monotonic()
+        sup.start()
+        sup.wait_ready(timeout_s=420)
+        startup_s = time.monotonic() - t0
+        rep: dict = {}
+
+        def load():
+            rep.update(run_closed_loop(
+                router, n_requests, concurrency=12, deadline_s=120.0,
+            ))
+
+        t = threading.Thread(target=load)
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if router.stats()["served"] >= n_requests // 10:
+                break
+            time.sleep(0.01)
+        os.kill(sup.slot_by_index(1).pid, _signal.SIGKILL)
+        t.join(timeout=300)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if sup.running_count() == 2:
+                break
+            time.sleep(0.2)
+        stats = router.stats()
+        return {
+            "value": round(rep["throughput_rps"], 1),
+            "unit": "requests/sec through a kill -9 drill",
+            "served": rep["served"],
+            "offered": n_requests,
+            "errors": rep["errors"],
+            "requeued": stats["requeued"],
+            "restarts": sup.restarts,
+            "recovery_s": (
+                round(sup.last_recovery_s, 2)
+                if sup.last_recovery_s is not None else None
+            ),
+            "startup_s": round(startup_s, 2),
+            "latency_ms": {
+                k: round(v * 1e3, 2)
+                for k, v in rep["latency_s"].items() if v is not None
+            },
+        }
+    finally:
+        sup.close()
+        router.stop(drain=False)
+
+
 def _serving_attribution(trace_dir, lint_report) -> "dict | None":
     """Measured device-time attribution of the serving load run
     (analysis/trace.py over the engine's own ``mpi4dl_serve_batch``
@@ -845,6 +929,11 @@ def main():
     if os.environ.get("BENCH_SERVING", "1") != "0":
         run_extra("serving_amoebanet3_32px", _measure_serving,
                   est_seconds=180.0)
+
+    # Fleet recovery drill (router + 2 CPU replica subprocesses + kill
+    # -9): rps-through-the-fault, requeue count, recovery latency.
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        run_extra("fleet_2replica", _measure_fleet, est_seconds=120.0)
 
     if which in ("resnet", "all") and not on_cpu:
         def peak_px():

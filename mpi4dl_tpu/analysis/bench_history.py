@@ -96,14 +96,19 @@ def extract_series(result: dict) -> "dict[str, float]":
             for b, v in by_bucket.items():
                 if isinstance(v, (int, float)):
                     out[f"{name}.peak_hbm_bytes[b{b}]"] = float(v)
+        # Fleet extra: death-to-replacement latency, trended so a
+        # slower recovery (a grown number) reads as the regression.
+        if isinstance(entry.get("recovery_s"), (int, float)):
+            out[f"{name}.recovery_s"] = float(entry["recovery_s"])
     return out
 
 
 def lower_is_better(key: str) -> bool:
-    """Memory series regress UPWARD: a grown footprint is the failure,
-    a shrunk one the improvement — the inverse of every throughput/
-    capability series."""
-    return "peak_hbm_bytes" in key
+    """Memory and recovery-latency series regress UPWARD: a grown
+    footprint or a slower death-to-replacement is the failure, a shrunk
+    one the improvement — the inverse of every throughput/capability
+    series."""
+    return "peak_hbm_bytes" in key or key.endswith(".recovery_s")
 
 
 def compare(rounds: "list[dict]", tolerance: float, strict: bool) -> dict:

@@ -34,7 +34,9 @@ orchestrator concern, not a framework one.
 
 from __future__ import annotations
 
+import collections
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -42,6 +44,114 @@ import time
 
 HEARTBEAT_ENV = "MPI4DL_TPU_HEARTBEAT"
 CHILD_ENV = "MPI4DL_TPU_SUPERVISED_CHILD"
+
+
+def full_jitter_backoff(
+    attempt: int,
+    base_s: float = 0.5,
+    max_s: float = 30.0,
+    rng=random.random,
+) -> float:
+    """AWS-style full-jitter exponential backoff: uniform in
+    ``[0, min(max_s, base_s * 2**(attempt-1))]``. Full jitter (rather
+    than a jittered fraction) is what decorrelates a fleet of
+    supervisors all restarting replicas that died of the same cause —
+    a thundering herd of synchronized respawns would re-trigger it.
+    ``attempt`` counts from 1; 0 or negative means no wait."""
+    if attempt <= 0 or base_s <= 0:
+        return 0.0
+    cap = min(float(max_s), float(base_s) * (2.0 ** (attempt - 1)))
+    return cap * rng()
+
+
+def restart_event(
+    attempt: int,
+    backoff_s: float,
+    reason: str,
+    events=None,
+    flight=None,
+    **attrs,
+) -> dict:
+    """Emit one schema-valid ``elastic.restart`` event (kind="event")
+    into the JSONL event log and/or flight ring; returns the event so
+    callers can also surface it inline. Supervisors — the single-process
+    :func:`supervise` and the fleet supervisor — share this shape, so
+    the postmortem story for "why did this process bounce" is one
+    query regardless of which babysitter did the bouncing."""
+    from mpi4dl_tpu.telemetry.jsonl import validate_event
+
+    ev = validate_event({
+        "ts": time.time(),
+        "kind": "event",
+        "name": "elastic.restart",
+        "attrs": {
+            "attempt": int(attempt),
+            "backoff_s": float(backoff_s),
+            "reason": str(reason),
+            **attrs,
+        },
+    })
+    if flight is not None and getattr(flight, "enabled", True):
+        flight.record(ev)
+    if events is not None and getattr(events, "enabled", True):
+        events.write(ev)
+    return ev
+
+
+class RestartBreaker:
+    """Max-restarts-per-window circuit breaker.
+
+    ``max_restarts`` failures recorded within ``window_s`` seconds trip
+    the breaker: :meth:`allow` answers False until :meth:`reset`.
+    ``window_s=None`` degrades to a lifetime budget (the pre-breaker
+    behavior of :func:`supervise`). A crash-looping child that fails K
+    times in a burst must stop being restarted — each respawn costs a
+    cold compile and can re-poison a shared accelerator — while a
+    process that fails K times across a week keeps its supervisor."""
+
+    def __init__(
+        self,
+        max_restarts: int,
+        window_s: "float | None" = None,
+        clock=time.monotonic,
+    ):
+        self.max_restarts = int(max_restarts)
+        self.window_s = None if window_s is None else float(window_s)
+        self._clock = clock
+        self._failures: collections.deque = collections.deque()
+        self.tripped = False
+
+    def record_failure(self) -> None:
+        self._failures.append(self._clock())
+
+    def _in_window(self) -> int:
+        if self.window_s is not None:
+            cutoff = self._clock() - self.window_s
+            while self._failures and self._failures[0] < cutoff:
+                self._failures.popleft()
+        return len(self._failures)
+
+    def allow(self) -> bool:
+        """May the supervisor restart now? Trips (sticky) when the
+        windowed failure count exceeds the budget."""
+        if self.tripped:
+            return False
+        if self._in_window() > self.max_restarts:
+            self.tripped = True
+            return False
+        return True
+
+    def reset(self) -> None:
+        self._failures.clear()
+        self.tripped = False
+
+    def state(self) -> dict:
+        return {
+            "tripped": self.tripped,
+            "failures_in_window": self._in_window(),
+            "max_restarts": self.max_restarts,
+            "window_s": self.window_s,
+        }
 
 
 def touch(path: str) -> None:
@@ -146,12 +256,25 @@ def supervise(
     heartbeat_path: str | None = None,
     resume_arg: str | None = "--resume",
     poll_interval: float = 0.5,
+    backoff_base_s: float = 0.5,
+    backoff_max_s: float = 30.0,
+    restart_window_s: "float | None" = None,
+    events=None,
+    flight=None,
+    rng=random.random,
+    _sleep=time.sleep,
     _print=None,
 ) -> int:
     """Run ``python argv`` under supervision; restart on failure.
 
     argv: script + args (``sys.argv`` of the training entry point).
     max_restarts: restarts allowed before giving up with the child's rc.
+        With ``restart_window_s`` set this is a per-window budget (a
+        :class:`RestartBreaker`): more than ``max_restarts`` failures
+        inside the window trips the breaker and gives up, while the same
+        count spread over a longer span keeps restarting — the
+        crash-loop / occasional-crash distinction. None keeps the
+        lifetime-budget behavior.
     hang_timeout: seconds of heartbeat staleness before the child is
         declared wedged and killed (None/0 disables hang detection). Must
         comfortably exceed the longest legitimate gap between steps — the
@@ -161,12 +284,22 @@ def supervise(
     resume_arg: appended to restarted children (skipped if already
         present) so they continue from the newest checkpoint instead of
         step 0. Pass None when the entry point auto-resumes.
+    backoff_base_s / backoff_max_s: exponential backoff with full jitter
+        (:func:`full_jitter_backoff`) before each restart — an
+        immediately-fatal environment (bad flag, poisoned device) must
+        not be hammered at poll speed, and jitter decorrelates sibling
+        supervisors. ``backoff_base_s=0`` restarts immediately.
+    events / flight: optional :class:`telemetry.JsonlWriter` /
+        :class:`telemetry.FlightRecorder`; every restart emits a
+        schema-valid ``elastic.restart`` event into both.
+    rng / _sleep: injectable for deterministic tests.
 
     Returns the final exit code (0 on eventual success).
     """
     if hang_timeout and not heartbeat_path:
         raise ValueError("hang_timeout needs a heartbeat_path")
     say = _print or (lambda m: print(m, flush=True))
+    breaker = RestartBreaker(max_restarts, window_s=restart_window_s)
     restarts = 0
     while True:
         cmd = [sys.executable] + list(argv)
@@ -219,16 +352,32 @@ def supervise(
                 say(f"elastic: completed after {restarts} restart(s)")
             return 0
         restarts += 1
-        if restarts > max_restarts:
+        breaker.record_failure()
+        if not breaker.allow():
+            window = (
+                f" within {restart_window_s:g}s"
+                if restart_window_s else ""
+            )
             say(
-                f"elastic: giving up after {max_restarts} restart(s) "
-                f"(last rc={rc})"
+                f"elastic: giving up after {max_restarts} restart(s)"
+                f"{window} (last rc={rc})"
             )
             return rc if rc not in (None, 0) else 1
+        reason = "wedged" if hung else f"rc={rc}"
+        backoff = full_jitter_backoff(
+            restarts, base_s=backoff_base_s, max_s=backoff_max_s, rng=rng
+        )
+        restart_event(
+            restarts, backoff, reason,
+            events=events, flight=flight, max_restarts=max_restarts,
+        )
         say(
             f"elastic: child {'wedged' if hung else f'failed rc={rc}'} — "
             f"restarting ({restarts}/{max_restarts})"
+            + (f" after {backoff:.2f}s backoff" if backoff > 0 else "")
         )
+        if backoff > 0:
+            _sleep(backoff)
 
 
 def maybe_supervise(args) -> None:

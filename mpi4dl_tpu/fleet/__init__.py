@@ -1,0 +1,63 @@
+"""Fault-tolerant replica fleet: router, supervised replicas, chaos drills.
+
+The "one engine is a component, the fleet is the product" layer
+(ROADMAP). PRs 4-7 built the sensors — ``/healthz`` + watchdog,
+health-gated heartbeats, ``/snapshotz`` federation, the fleet-wide
+``autoscale_desired_replicas`` gauge, cross-process trace ids — and this
+package actuates on them:
+
+- :class:`Router` (:mod:`.router`) — front-end admission + health-aware
+  dispatch over N replica processes, with a per-replica in-flight ledger
+  and requeue-on-death (exactly-once completion by construction);
+- :class:`FleetSupervisor` (:mod:`.supervisor`) — reconciles the fleet
+  toward the desired-replica gauge: spawn with backoff + jitter, drain
+  on scale-down, replace on heartbeat loss / ``/healthz`` 503, per-slot
+  circuit breaker paged through the stock alert machinery;
+- :mod:`.worker` — the replica process (``python -m
+  mpi4dl_tpu.fleet.worker``): one ServingEngine + predict RPC endpoint
+  + the chaos hooks;
+- :mod:`.chaos` — the fault-injection harness (``--chaos kill:1``...):
+  the drills the tier-1 tests run, on tap against a live fleet;
+- ``python -m mpi4dl_tpu.fleet`` — spawn a fleet, load it, optionally
+  break it, print one JSON report.
+
+See ``docs/FLEET.md`` for topology, requeue/exactly-once semantics,
+breaker parameters, and the chaos runbook.
+"""
+
+from mpi4dl_tpu.fleet.chaos import (  # noqa: F401
+    ChaosMonkey,
+    ChaosOp,
+    parse_chaos_spec,
+    parse_chaos_specs,
+)
+from mpi4dl_tpu.fleet.replica import (  # noqa: F401
+    ReplicaClient,
+    ReplicaDeadline,
+    ReplicaError,
+    ReplicaProcess,
+    ReplicaQueueFull,
+    ReplicaRemoteError,
+    ReplicaUnreachable,
+    worker_cmd,
+)
+from mpi4dl_tpu.fleet.router import (  # noqa: F401
+    ROUTER_METRICS,
+    FleetRequestError,
+    Router,
+)
+from mpi4dl_tpu.fleet.supervisor import (  # noqa: F401
+    SUPERVISOR_METRICS,
+    FleetSupervisor,
+)
+
+
+def declare_metrics(registry) -> None:
+    """Declare every ``fleet_*`` metric on ``registry`` (the router and
+    supervisor each declare their own subset at construction; this is
+    the one-call version for catalog pins and dashboards that want the
+    names present before a fleet exists)."""
+    from mpi4dl_tpu import telemetry
+
+    for name in sorted({*ROUTER_METRICS, *SUPERVISOR_METRICS}):
+        telemetry.declare(registry, name)

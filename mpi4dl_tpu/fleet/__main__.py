@@ -1,0 +1,280 @@
+"""``python -m mpi4dl_tpu.fleet`` — spawn a replica fleet, load it, break it.
+
+Builds a router + N supervised replica workers (synthetic calibrated
+ResNet each — no artifacts needed), runs the requested load model
+THROUGH the router, optionally injects chaos mid-run (``--chaos
+kill:1``: the drills of :mod:`mpi4dl_tpu.fleet.chaos`), waits for the
+supervisor to restore the fleet, and prints ONE JSON report line to
+stdout (bench.py's keep-the-last-line protocol) with the loadgen
+numbers, requeue counts, restart log, and recovery latency.
+
+``--plan`` is the pure-dispatch mode: parse everything, print the fleet
+plan as JSON, exit — no processes, no compiles, no devices (the CLI
+smoke-test surface).
+
+Examples::
+
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.fleet --replicas 2 \
+        --requests 128 --concurrency 16
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.fleet --replicas 2 \
+        --chaos kill:1@1.5 --requests 256 --json /tmp/drill.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.fleet",
+        description="mpi4dl_tpu replica fleet: router + supervised "
+                    "replicas + chaos drills",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--replicas", type=int, default=2,
+                   help="initial replica count (the autoscale floor)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscale ceiling (default: --replicas)")
+    p.add_argument("--chaos", action="append", default=[],
+                   metavar="SPEC",
+                   help="fault injection, repeatable: "
+                        "ACTION[:TARGET][=SECONDS][@AT] with actions "
+                        "kill, wedge, blackhole, delay-scrape — e.g. "
+                        "kill:1@1.5 (SIGKILL replica 1, 1.5s into load)")
+    p.add_argument("--plan", action="store_true",
+                   help="print the fleet plan as JSON and exit without "
+                        "spawning anything (pure dispatch)")
+    # worker / model
+    p.add_argument("--image-size", type=int, default=16)
+    p.add_argument("--depth", type=int, default=None,
+                   help="synthetic ResNet-v2 depth (9n+2); default tiny")
+    p.add_argument("--max-batch", type=int, default=2)
+    p.add_argument("--replica-max-queue", type=int, default=64)
+    p.add_argument("--worker-watchdog-min-timeout", type=float, default=1.0,
+                   help="replica stall-detector floor; drills keep it "
+                        "small so a wedge is declared fast")
+    # router
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="router admission bound")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="dispatch errors per request before its future "
+                        "fails (typed)")
+    p.add_argument("--inflight-per-replica", type=int, default=4)
+    # supervision
+    p.add_argument("--heartbeat-timeout", type=float, default=5.0)
+    p.add_argument("--breaker-max-restarts", type=int, default=3)
+    p.add_argument("--breaker-window", type=float, default=60.0)
+    p.add_argument("--no-federation", action="store_true",
+                   help="static desired-replica count instead of the "
+                        "federated autoscale gauge")
+    p.add_argument("--recovery-timeout", type=float, default=180.0,
+                   help="post-load wait for the supervisor to restore "
+                        "the fleet to the desired count")
+    # load
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--requests", type=int, default=128,
+                   help="closed loop: total requests")
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="open loop: offered requests/sec")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--deadline-ms", type=float, default=30000.0)
+    p.add_argument("--queue-full-retries", type=int, default=0)
+    # observability
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve the router registry (fleet_* + federated "
+                        "view) on this port (0 = ephemeral)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="JSONL span logs (router + every replica) land "
+                        "here; default: a temp dir, echoed on stderr — "
+                        "feed it to `analyze trace-export`")
+    p.add_argument("--spawn-timeout", type=float, default=600.0)
+    p.add_argument("--json", dest="json_out", default=None)
+    return p
+
+
+def plan(args) -> dict:
+    """The pure-dispatch fleet plan (validated chaos specs included) —
+    what `--plan` prints and the CLI smoke asserts on."""
+    from mpi4dl_tpu.fleet.chaos import parse_chaos_specs
+    from mpi4dl_tpu.fleet.replica import worker_cmd
+
+    ops = parse_chaos_specs(args.chaos)
+    for op in ops:
+        if op.target >= args.replicas:
+            raise ValueError(
+                f"chaos target r{op.target} outside --replicas "
+                f"{args.replicas}"
+            )
+    return {
+        "replicas": args.replicas,
+        "max_replicas": args.max_replicas or args.replicas,
+        "mode": args.mode,
+        "chaos": [op.describe() for op in ops],
+        "worker_cmd": worker_cmd(_worker_args(args)),
+        "federation": not args.no_federation,
+    }
+
+
+def _worker_args(args) -> "list[str]":
+    out = [
+        "--image-size", str(args.image_size),
+        "--max-batch", str(args.max_batch),
+        "--max-queue", str(args.replica_max_queue),
+        "--watchdog-min-timeout", str(args.worker_watchdog_min_timeout),
+    ]
+    if args.depth is not None:
+        out += ["--depth", str(args.depth)]
+    if args.telemetry_dir:
+        out += ["--telemetry-dir", args.telemetry_dir]
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        the_plan = plan(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.plan:
+        print(json.dumps(the_plan))
+        return 0
+
+    import tempfile
+
+    from mpi4dl_tpu import telemetry
+    from mpi4dl_tpu.fleet.chaos import ChaosMonkey, parse_chaos_specs
+    from mpi4dl_tpu.fleet.router import Router
+    from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop, run_open_loop
+    from mpi4dl_tpu.telemetry.autoscale import AutoscaleConfig
+
+    if not args.telemetry_dir:
+        args.telemetry_dir = tempfile.mkdtemp(prefix="mpi4dl-fleet-tele-")
+        print(f"# telemetry: {args.telemetry_dir}", file=sys.stderr,
+              flush=True)
+
+    size = args.image_size
+    router = Router(
+        example_shape=(size, size, 3),
+        max_queue=args.max_queue,
+        default_deadline_s=args.deadline_ms / 1e3,
+        max_attempts=args.max_attempts,
+        inflight_per_replica=args.inflight_per_replica,
+        telemetry_dir=args.telemetry_dir,
+    )
+    federation = None
+    if not args.no_federation:
+        federation = telemetry.SLOConfig(
+            availability=0.999, interval_s=1.0,
+            autoscale=AutoscaleConfig(
+                min_replicas=args.replicas,
+                max_replicas=args.max_replicas or args.replicas,
+            ),
+        )
+    sup = FleetSupervisor(
+        _worker_args(args),
+        router=router,
+        replicas=args.replicas,
+        max_replicas=args.max_replicas or args.replicas,
+        federation=federation,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        breaker_max_restarts=args.breaker_max_restarts,
+        breaker_window_s=args.breaker_window,
+        spawn_timeout_s=args.spawn_timeout,
+    )
+    server = None
+    if args.metrics_port is not None:
+        registry = (
+            sup.aggregator.registry if sup.aggregator is not None
+            else router.registry
+        )
+        server = telemetry.MetricsServer(
+            registry, port=args.metrics_port,
+            health=router.health_snapshot,
+            debug=lambda: {
+                "router": router.stats(), "supervisor": sup.state(),
+            },
+        )
+        print(
+            f"# metrics: http://127.0.0.1:{server.port}/metrics "
+            "(also /snapshotz, /healthz, /debugz)",
+            file=sys.stderr, flush=True,
+        )
+
+    report = {"fleet": the_plan}
+    rc = 0
+    monkey = None
+    try:
+        t_up = time.monotonic()
+        sup.start()
+        sup.wait_ready(timeout_s=args.spawn_timeout)
+        report["fleet"]["startup_s"] = time.monotonic() - t_up
+        print(
+            f"# fleet up: {sup.running_count()} replica(s) in "
+            f"{report['fleet']['startup_s']:.1f}s",
+            file=sys.stderr, flush=True,
+        )
+
+        monkey = ChaosMonkey(parse_chaos_specs(args.chaos), sup)
+        monkey.start()
+        if args.mode == "closed":
+            report["loadgen"] = run_closed_loop(
+                router, args.requests, concurrency=args.concurrency,
+                deadline_s=args.deadline_ms / 1e3, events=router.events,
+                queue_full_retries=args.queue_full_retries,
+            )
+        else:
+            report["loadgen"] = run_open_loop(
+                router, rate_rps=args.rate, duration_s=args.duration,
+                deadline_s=args.deadline_ms / 1e3, events=router.events,
+                queue_full_retries=args.queue_full_retries,
+            )
+
+        # Post-load: the drill isn't over until every scheduled chaos op
+        # has actually fired (a fast load run must not outrun its own
+        # drill) AND the supervisor has restored the fleet (or the
+        # recovery window expires — reported either way, failed loudly
+        # when chaos was requested).
+        deadline = time.monotonic() + args.recovery_timeout
+        n_ops = len(monkey.ops)
+        while time.monotonic() < deadline and len(monkey.log) < n_ops:
+            time.sleep(0.1)
+        while time.monotonic() < deadline:
+            if (
+                len(monkey.log) >= n_ops
+                and sup.running_count() >= sup.desired_replicas()
+            ):
+                break
+            time.sleep(0.25)
+        restored = sup.running_count() >= sup.desired_replicas()
+        report["chaos"] = monkey.log
+        report["supervisor"] = sup.state()
+        report["router"] = router.stats()
+        report["recovered"] = restored
+        report["recovery_s"] = sup.last_recovery_s
+        if args.chaos and not restored:
+            rc = 1
+    finally:
+        if monkey is not None:
+            monkey.close()
+        sup.close()
+        router.stop(drain=False)
+        if server is not None:
+            server.close()
+
+    line = json.dumps(report)
+    print(line, flush=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

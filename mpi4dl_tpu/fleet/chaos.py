@@ -1,0 +1,167 @@
+"""Fault-injection harness: the drills the fleet must survive, on tap.
+
+A fault-tolerance layer that has never seen a fault is a hypothesis, not
+a feature. This module makes the failure modes the router + supervisor
+claim to handle injectable on demand — the SAME drills the tier-1 tests
+run (``tests/test_fleet.py``), exposed as ``--chaos`` CLI flags so an
+operator can rehearse them against a live fleet:
+
+- ``kill`` — ``SIGKILL`` the replica process (no cleanup, no flush: the
+  hard-down case; in-flight RPCs die with the sockets and the router
+  requeues them on survivors);
+- ``wedge`` — block the replica's batcher mid-loop while its submit
+  path, HTTP threads, and heartbeat *machinery* stay alive (the
+  wedged-but-alive shape, SURVEY §5.3 — only the watchdog-gated
+  heartbeat going silent exposes it);
+- ``blackhole`` — make the replica's ``/healthz`` hang instead of
+  answering (probe black-hole: the router's scrape must time out and
+  count it down, not wait forever);
+- ``delay-scrape`` — add seconds of latency to ``/snapshotz`` (slow
+  telemetry must degrade the *federation view*, never the serving path).
+
+Spec grammar (``--chaos``, repeatable)::
+
+    ACTION[:TARGET][@AT[s]]
+
+    kill:1          SIGKILL replica index 1 (at the default +1.0s)
+    wedge:0@2.5     wedge replica 0's batcher 2.5s into the load run
+    delay-scrape:1=3@2   delay r1's /snapshotz by 3s from t=+2s
+
+``TARGET`` is the replica *slot index* (default 0); ``AT`` is seconds
+after the load run starts; ``=SECONDS`` (delay-scrape only) is the added
+latency. Parsing is pure stdlib — ``--plan`` dispatch and the CLI smoke
+never touch a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+
+ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape")
+
+_SPEC_RE = re.compile(
+    r"^(?P<action>[a-z-]+)"
+    r"(?::(?P<target>\d+))?"
+    r"(?:=(?P<seconds>\d+(?:\.\d+)?))?"
+    r"(?:@(?P<at>\d+(?:\.\d+)?)s?)?$"
+)
+
+
+@dataclasses.dataclass
+class ChaosOp:
+    """One scheduled fault injection."""
+
+    action: str
+    target: int = 0        # replica slot index
+    at_s: float = 1.0      # seconds after the load run starts
+    seconds: float = 3.0   # delay-scrape only: added latency
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {self.action!r}; expected one of "
+                f"{ACTIONS}"
+            )
+        if self.target < 0 or self.at_s < 0 or self.seconds <= 0:
+            raise ValueError(f"invalid chaos op: {self}")
+
+    def describe(self) -> str:
+        extra = f"={self.seconds:g}s" if self.action == "delay-scrape" else ""
+        return f"{self.action}:r{self.target}{extra}@+{self.at_s:g}s"
+
+
+def parse_chaos_spec(spec: str) -> ChaosOp:
+    """``ACTION[:TARGET][=SECONDS][@AT]`` → :class:`ChaosOp`; raises
+    ``ValueError`` naming the problem (argparse turns it into a usage
+    error)."""
+    m = _SPEC_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"bad chaos spec {spec!r}; expected ACTION[:TARGET][=SECONDS]"
+            f"[@AT], e.g. kill:1 or wedge:0@2.5 (actions: {ACTIONS})"
+        )
+    kw = {"action": m.group("action")}
+    if m.group("target") is not None:
+        kw["target"] = int(m.group("target"))
+    if m.group("at") is not None:
+        kw["at_s"] = float(m.group("at"))
+    if m.group("seconds") is not None:
+        kw["seconds"] = float(m.group("seconds"))
+    return ChaosOp(**kw)
+
+
+def parse_chaos_specs(specs) -> "list[ChaosOp]":
+    return [parse_chaos_spec(s) for s in specs or ()]
+
+
+def inject(op: ChaosOp, supervisor) -> dict:
+    """Apply one op against a live fleet NOW. ``kill`` goes straight to
+    the OS (the point is that the victim gets no say); the soft faults
+    go through the victim's own ``/chaos`` endpoint. Returns a record of
+    what was done (the CLI report embeds it)."""
+    slot = supervisor.slot_by_index(op.target)
+    if slot is None:
+        raise ValueError(
+            f"chaos target index {op.target} has no live replica"
+        )
+    record = {"op": op.describe(), "replica": slot.name, "ts": time.time()}
+    if op.action == "kill":
+        record["pid"] = slot.pid
+        slot.kill_hard()
+        return record
+    actions = {
+        "wedge": {"action": "wedge"},
+        "blackhole": {"action": "blackhole_healthz"},
+        "delay-scrape": {"action": "delay_scrape", "seconds": op.seconds},
+    }
+    record.update(slot.client.chaos(**actions[op.action]))
+    return record
+
+
+class ChaosMonkey:
+    """Schedules :class:`ChaosOp` injections relative to a start mark.
+
+    Built for drills, so it is deliberately boring: a daemon thread,
+    ops sorted by ``at_s``, each applied once; failures are recorded
+    (a drill against an already-dead replica must not kill the drill
+    runner). ``log`` holds what actually happened."""
+
+    def __init__(self, ops, supervisor):
+        self.ops = sorted(ops, key=lambda o: o.at_s)
+        self.supervisor = supervisor
+        self.log: "list[dict]" = []
+        self._stop_evt = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def start(self) -> None:
+        if not self.ops or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="mpi4dl-chaos", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for op in self.ops:
+            delay = op.at_s - (time.monotonic() - t0)
+            if delay > 0 and self._stop_evt.wait(delay):
+                return
+            try:
+                self.log.append(inject(op, self.supervisor))
+            except Exception as e:  # noqa: BLE001 — a failed injection
+                # is drill data, not a drill crash
+                self.log.append({
+                    "op": op.describe(),
+                    "error": f"{type(e).__name__}: {e}",
+                    "ts": time.time(),
+                })
+
+    def close(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

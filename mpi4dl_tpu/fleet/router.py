@@ -1,0 +1,726 @@
+"""The fleet front end: admission, health-aware dispatch, requeue-on-death.
+
+One :class:`~mpi4dl_tpu.serve.ServingEngine` is a component; the fleet is
+the product (ROADMAP). The router owns the client-facing surface of N
+replica processes:
+
+- **Admission.** ``submit()`` mirrors the engine's contract — bounded
+  queue, :class:`~mpi4dl_tpu.serve.QueueFullError` with a
+  ``retry_after_s`` hint, per-request deadline, a ``Future`` per
+  request — so the existing load generators (and any engine client)
+  drive a fleet unchanged.
+- **Dispatch.** Each replica gets ``inflight_per_replica`` dispatcher
+  threads pulling from the shared queue; a replica only pulls while its
+  scraped ``/healthz`` says healthy, it isn't draining/backing off, and
+  it has a free in-flight slot — so load balances toward the replicas
+  that are actually absorbing work (busy or unhealthy replicas simply
+  stop pulling), and queue depth scraped off ``/healthz`` can gate a
+  replica whose engine queue is already deep (``replica_depth_limit``).
+- **In-flight ledger + requeue.** Every dispatched request sits in its
+  replica's ledger until the RPC resolves. A dead replica (connection
+  refused/reset, RPC timeout, or :meth:`remove_replica` from the
+  supervisor on confirmed death) gets its ledger REQUEUED onto
+  survivors. Completion is exactly-once by construction: a per-request
+  state machine (``pending → inflight → done``) guarded by a lock, with
+  a dispatch **epoch** that makes stale requeues/completions no-ops —
+  a future is never double-completed, and a request already re-dispatched
+  to a survivor cannot be requeued again by the dead replica's
+  late-failing RPC thread.
+- **Tracing.** The router mints each request's trace id (callers may
+  pass their own) and emits ``router.request`` / per-attempt
+  ``router.dispatch`` span segments into its JSONL log, so ``python -m
+  mpi4dl_tpu.analyze trace-export`` renders a requeued request's full
+  client → router → dead-replica → survivor lifetime even though the
+  dead replica never flushed its own spans.
+
+Failure semantics: every accepted request's future resolves — with
+logits, or with a TYPED error (:class:`DeadlineExceededError`,
+:class:`FleetRequestError` after ``max_attempts`` dispatch errors,
+:class:`~mpi4dl_tpu.serve.DrainedError` on router stop). Queue-full
+bounces at a replica do not count against the attempt budget (the
+replica is alive — the deadline bounds the retry loop); dispatch errors
+do.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.fleet.replica import (
+    ReplicaClient,
+    ReplicaDeadline,
+    ReplicaError,
+    ReplicaQueueFull,
+    ReplicaUnreachable,
+)
+from mpi4dl_tpu.profiling import percentiles
+
+
+class FleetRequestError(RuntimeError):
+    """Terminal dispatch failure: the retry budget is spent and no
+    replica could serve the request. Carries the attempt history so the
+    caller sees which replicas were tried and why the last one failed."""
+
+    def __init__(self, msg: str, attempts: int = 0, replicas=(),
+                 last_error: "Exception | None" = None):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.replicas = tuple(replicas)
+        self.last_error = last_error
+
+
+#: fleet_* metric names the router publishes (the supervisor adds its
+#: own set; both go through telemetry.declare, so the catalog is the
+#: single source of truth).
+ROUTER_METRICS = (
+    "fleet_requests_total",
+    "fleet_requeues_total",
+    "fleet_dispatches_total",
+    "fleet_inflight",
+    "fleet_replicas",
+)
+
+
+class _Record:
+    """One accepted request's lifecycle. The lock guards the state
+    machine; ``epoch`` increments per dispatch so stale requeues and
+    completions (from a replica declared dead while its RPC was still
+    in flight) are detectable no-ops."""
+
+    __slots__ = (
+        "x", "submit_t", "deadline", "future", "trace_id", "lock",
+        "state", "epoch", "attempts", "history", "first_dispatch_t",
+        "last_error",
+    )
+
+    def __init__(self, x, submit_t, deadline, future, trace_id):
+        self.x = x
+        self.submit_t = submit_t
+        self.deadline = deadline
+        self.future = future
+        self.trace_id = trace_id
+        self.lock = threading.Lock()
+        self.state = "pending"
+        self.epoch = 0
+        self.attempts = 0
+        self.history: "list[str]" = []
+        self.first_dispatch_t: "float | None" = None
+        self.last_error: "Exception | None" = None
+
+
+class _Replica:
+    """Router-side view of one replica: client, scraped health, ledger."""
+
+    def __init__(self, name: str, predict_url: str, health_url: str):
+        self.name = name
+        self.client = ReplicaClient(name, predict_url)
+        self.health_url = health_url.rstrip("/") + "/healthz"
+        self.healthy = True          # optimistic until the first scrape
+        self.queue_depth: "float | None" = None
+        self.scrape_failures = 0
+        self.backoff_until = 0.0
+        self.draining = False
+        self.removed = False
+        self.inflight: "dict[str, _Record]" = {}
+        self.threads: "list[threading.Thread]" = []
+
+    def accepting(self, now: float, depth_limit: "int | None") -> bool:
+        if self.removed or self.draining or not self.healthy:
+            return False
+        if now < self.backoff_until:
+            return False
+        if (
+            depth_limit is not None
+            and self.queue_depth is not None
+            and self.queue_depth >= depth_limit
+        ):
+            return False
+        return True
+
+    def state(self) -> dict:
+        return {
+            "name": self.name,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "removed": self.removed,
+            "queue_depth": self.queue_depth,
+            "inflight": len(self.inflight),
+            "scrape_failures": self.scrape_failures,
+        }
+
+
+class Router:
+    """Front-end admission + dispatch over N replica predict endpoints.
+
+    example_shape / dtype: the per-request input contract (mirrors
+        :class:`ServingEngine`, so load generators work unchanged).
+    registry: shared :class:`telemetry.MetricsRegistry`; the router
+        declares and publishes the ``fleet_*`` router metrics on it.
+    max_queue: admission bound on requests waiting for a dispatcher.
+    max_attempts: dispatch ERRORS allowed per request before its future
+        fails with :class:`FleetRequestError` (queue-full bounces are
+        not errors and don't count — the deadline bounds those).
+    inflight_per_replica: dispatcher threads (= max concurrent RPCs)
+        per replica.
+    replica_depth_limit: optional scraped-queue-depth gate — a replica
+        whose engine queue is at/over this stops pulling until it
+        drains below.
+    health_interval_s / scrape_timeout_s: the ``/healthz`` scrape loop.
+        The worker enriches its health payload with ``queue_depth``, so
+        one cheap endpoint feeds both signals.
+    dispatch_timeout_s: per-RPC cap; None = the request's remaining
+        deadline (+1s grace for the response to travel).
+    events / telemetry_dir: span-segment sink (``events`` wins; a
+        shared :class:`telemetry.JsonlWriter` lets the in-process load
+        generator's client segments land in the same file).
+    """
+
+    def __init__(
+        self,
+        example_shape,
+        dtype: str = "float32",
+        registry=None,
+        max_queue: int = 256,
+        default_deadline_s: float = 30.0,
+        max_attempts: int = 3,
+        inflight_per_replica: int = 8,
+        replica_depth_limit: "int | None" = None,
+        health_interval_s: float = 0.25,
+        scrape_timeout_s: float = 1.0,
+        dispatch_timeout_s: "float | None" = None,
+        events=None,
+        telemetry_dir: "str | None" = None,
+    ):
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self._np_dtype = np.dtype(dtype)
+        self.registry = (
+            registry if registry is not None else telemetry.MetricsRegistry()
+        )
+        self._events = (
+            events if events is not None
+            else telemetry.JsonlWriter(telemetry_dir)
+        )
+        self._owns_events = events is None
+        self._max_queue = int(max_queue)
+        self._default_deadline_s = float(default_deadline_s)
+        self._max_attempts = int(max_attempts)
+        self._inflight_per_replica = int(inflight_per_replica)
+        self._depth_limit = replica_depth_limit
+        self._health_interval_s = float(health_interval_s)
+        self._scrape_timeout_s = float(scrape_timeout_s)
+        self._dispatch_timeout_s = dispatch_timeout_s
+
+        self._m_requests = telemetry.declare(
+            self.registry, "fleet_requests_total"
+        )
+        self._m_requeues = telemetry.declare(
+            self.registry, "fleet_requeues_total"
+        )
+        self._m_dispatches = telemetry.declare(
+            self.registry, "fleet_dispatches_total"
+        )
+        self._m_inflight = telemetry.declare(self.registry, "fleet_inflight")
+        self._m_replicas = telemetry.declare(self.registry, "fleet_replicas")
+        self._m_replicas.set(0, state="configured")
+        self._m_replicas.set(0, state="healthy")
+
+        self._cond = threading.Condition()
+        self._pending: "collections.deque[_Record]" = collections.deque()
+        self._replicas: "dict[str, _Replica]" = {}
+        self._lock = threading.Lock()  # replica map + counters
+        self._counts = {
+            "submitted": 0, "served": 0, "failed": 0,
+            "rejected_queue_full": 0, "rejected_deadline": 0,
+            "drained": 0, "requeued": 0,
+        }
+        self._latencies: "list[float]" = []
+        self._stopping = False
+        self._scrape_stop = threading.Event()
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, name="mpi4dl-router-health",
+            daemon=True,
+        )
+        self._scrape_thread.start()
+
+    # -- replica membership ---------------------------------------------------
+
+    def add_replica(
+        self, name: str, predict_url: str, health_url: "str | None" = None
+    ) -> None:
+        """Register a replica (the supervisor calls this once the worker's
+        ready handshake lands). Re-adding an existing name replaces the
+        entry — the respawned incarnation of a slot."""
+        rep = _Replica(name, predict_url, health_url or predict_url)
+        with self._lock:
+            old = self._replicas.get(name)
+            self._replicas[name] = rep
+            self._m_replicas.set(len(self._replicas), state="configured")
+        if old is not None:
+            old.removed = True
+        for _ in range(self._inflight_per_replica):
+            t = threading.Thread(
+                target=self._dispatch_loop, args=(rep,),
+                name=f"mpi4dl-router-{name}", daemon=True,
+            )
+            rep.threads.append(t)
+            t.start()
+        with self._cond:
+            self._cond.notify_all()
+
+    def remove_replica(self, name: str, requeue: bool = True) -> int:
+        """Drop a replica from dispatch. ``requeue=True`` is the
+        DEAD-replica path (supervisor-confirmed): every request in its
+        in-flight ledger goes back on the queue for survivors. Only call
+        with ``requeue=True`` once the process is actually gone —
+        requeueing work a live replica is still executing is how
+        double-execution happens. Returns the number requeued."""
+        with self._lock:
+            rep = self._replicas.pop(name, None)
+            self._m_replicas.set(len(self._replicas), state="configured")
+        if rep is None:
+            return 0
+        rep.removed = True
+        with self._cond:
+            self._cond.notify_all()
+        n = 0
+        if requeue:
+            for rec in list(rep.inflight.values()):
+                with rec.lock:
+                    epoch = rec.epoch
+                if self._requeue(
+                    rec, epoch, reason="replica_removed",
+                    count_attempt=False,
+                ):
+                    n += 1
+        rep.inflight.clear()
+        self._m_inflight.set(0, replica=name)
+        return n
+
+    def drain_replica(self, name: str, timeout_s: float = 10.0) -> bool:
+        """Scale-down drain: stop routing new work to the replica, then
+        wait for its in-flight ledger to flush. Returns True when the
+        ledger emptied (the caller may now terminate the process)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            return True
+        rep.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not rep.inflight:
+                return True
+            time.sleep(0.02)
+        return not rep.inflight
+
+    def replicas(self) -> "list[dict]":
+        with self._lock:
+            return [r.state() for r in self._replicas.values()]
+
+    # -- client surface (engine-shaped: loadgen drives it unchanged) ----------
+
+    @property
+    def events(self):
+        return self._events
+
+    def submit(
+        self,
+        x,
+        deadline_s: "float | None" = None,
+        trace_id: "str | None" = None,
+    ):
+        """Admit one request; returns a ``Future``. Mirrors
+        :meth:`ServingEngine.submit` (queue-full/deadline semantics,
+        trace-id propagation) so engine clients need no changes."""
+        from concurrent.futures import Future
+
+        from mpi4dl_tpu.serve.engine import QueueFullError
+
+        x = np.asarray(x, self._np_dtype)
+        if x.shape != self.example_shape:
+            raise ValueError(
+                f"example shape {x.shape} != configured {self.example_shape}"
+            )
+        if self._stopping:
+            raise RuntimeError("router is stopped")
+        now = time.monotonic()
+        ddl = now + (
+            deadline_s if deadline_s is not None else self._default_deadline_s
+        )
+        rec = _Record(
+            x=x, submit_t=now, deadline=ddl, future=Future(),
+            trace_id=(
+                str(trace_id) if trace_id else telemetry.new_trace_id("fleet")
+            ),
+        )
+        with self._cond:
+            if len(self._pending) >= self._max_queue:
+                with self._lock:
+                    self._counts["rejected_queue_full"] += 1
+                self._m_requests.inc(outcome="rejected_queue_full")
+                raise QueueFullError(
+                    f"router queue full ({self._max_queue} waiting)",
+                    retry_after_s=0.05,
+                )
+            self._pending.append(rec)
+            self._cond.notify()
+        with self._lock:
+            self._counts["submitted"] += 1
+        return rec.future
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counts)
+            lat = list(self._latencies)
+        out["latency_s"] = percentiles(lat)
+        out["queue_depth"] = len(self._pending)
+        out["replicas"] = self.replicas()
+        return out
+
+    def health_snapshot(self) -> dict:
+        reps = self.replicas()
+        up = [r for r in reps if r["healthy"] and not r["removed"]]
+        healthy = bool(up)
+        return {
+            "healthy": healthy,
+            "reason": (
+                "ok" if healthy else "no healthy replica accepting work"
+            ),
+            "queue_depth": len(self._pending),
+            "replicas": reps,
+        }
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop dispatching. ``drain=True`` waits (bounded) for queued +
+        in-flight work to finish first; whatever remains is failed with
+        :class:`DrainedError` (outcome ``drained`` — a lifecycle event,
+        not an availability failure)."""
+        if drain:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    busy = any(
+                        r.inflight for r in self._replicas.values()
+                    )
+                if not self._pending and not busy:
+                    break
+                time.sleep(0.02)
+        self._stopping = True
+        self._scrape_stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        self._scrape_thread.join(timeout=5)
+        from mpi4dl_tpu.serve.engine import DrainedError
+
+        while True:
+            with self._cond:
+                if not self._pending:
+                    break
+                rec = self._pending.popleft()
+            with rec.lock:
+                if rec.state == "done":
+                    continue
+                rec.state = "done"
+            with self._lock:
+                self._counts["drained"] += 1
+            self._m_requests.inc(outcome="drained")
+            rec.future.set_exception(DrainedError(
+                "router stopped before this request was dispatched"
+            ))
+        if self._owns_events:
+            self._events.close()
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _dispatch_loop(self, rep: _Replica) -> None:
+        while True:
+            rec = None
+            with self._cond:
+                while True:
+                    if self._stopping or rep.removed:
+                        return
+                    if (
+                        self._pending
+                        and rep.accepting(time.monotonic(), self._depth_limit)
+                    ):
+                        rec = self._pending.popleft()
+                        if (
+                            rec.attempts
+                            and rec.history
+                            and rec.history[-1] == rep.name
+                            and len(self._replicas) > 1
+                        ):
+                            # Re-dispatch dedupe: a request that just
+                            # FAILED here goes to a different replica
+                            # while one exists; only a one-replica
+                            # fleet retries in place.
+                            self._pending.appendleft(rec)
+                            self._cond.wait(0.02)
+                            continue
+                        break
+                    # Timed wait: health/backoff state changes outside
+                    # the condition (scrape loop) must be re-checked.
+                    self._cond.wait(0.05)
+            try:
+                self._dispatch_one(rep, rec)
+            except Exception as e:  # noqa: BLE001 — a dispatcher dying
+                # would strand its record; fail it loudly instead.
+                self._fail(rec, rec.epoch, e)
+
+    def _dispatch_one(self, rep: _Replica, rec: _Record) -> None:
+        now = time.monotonic()
+        with rec.lock:
+            if rec.state == "done":
+                return
+            if now > rec.deadline:
+                rec.state = "done"
+                terminal_deadline = True
+            else:
+                terminal_deadline = False
+                rec.state = "inflight"
+                rec.epoch += 1
+                epoch = rec.epoch
+                rec.history.append(rep.name)
+                if rec.first_dispatch_t is None:
+                    rec.first_dispatch_t = now
+        if terminal_deadline:
+            self._deliver_deadline(rec, "expired while queued at the router")
+            return
+        rep.inflight[rec.trace_id] = rec
+        self._m_inflight.set(len(rep.inflight), replica=rep.name)
+        remaining = rec.deadline - now
+        timeout = remaining + 1.0  # grace: let the engine's own
+        # deadline machinery answer 504 before the socket gives up
+        if self._dispatch_timeout_s is not None:
+            timeout = min(timeout, self._dispatch_timeout_s)
+        t0 = now
+        outcome, payload, logits, error = "ok", None, None, None
+        try:
+            logits, payload = rep.client.predict(
+                rec.x, rec.trace_id, deadline_s=remaining, timeout_s=timeout,
+            )
+        except ReplicaQueueFull as e:
+            outcome, error = "queue_full", e
+            rep.backoff_until = time.monotonic() + (e.retry_after_s or 0.02)
+        except ReplicaDeadline as e:
+            outcome, error = "deadline", e
+        except ReplicaUnreachable as e:
+            # Connection refused/reset/timed out: the strongest death
+            # signal there is. Mark the replica down IMMEDIATELY (before
+            # the requeue) so survivors' dispatchers — not this
+            # replica's — pick the request up; the scrape loop restores
+            # `healthy` the moment a probe answers again.
+            outcome, error = "error", e
+            rep.scrape_failures += 1
+            rep.healthy = False
+        except ReplicaError as e:
+            outcome, error = "error", e
+            rep.scrape_failures += 1
+            if rep.scrape_failures >= 2:
+                # Two straight failures: stop pulling until a scrape
+                # says otherwise (the scrape loop resets on success).
+                rep.healthy = False
+        rep.inflight.pop(rec.trace_id, None)
+        self._m_inflight.set(len(rep.inflight), replica=rep.name)
+        self._m_dispatches.inc(replica=rep.name, outcome=outcome)
+        self._emit_dispatch_span(rec, rep, t0, time.monotonic(), outcome)
+        if outcome == "ok":
+            self._complete(rec, epoch, logits, payload)
+        elif outcome == "deadline":
+            with rec.lock:
+                stale = rec.state != "inflight" or rec.epoch != epoch
+                if not stale:
+                    rec.state = "done"
+            if not stale:
+                self._deliver_deadline(rec, str(error))
+        elif outcome == "queue_full":
+            self._requeue(
+                rec, epoch, reason="replica_queue_full", count_attempt=False,
+            )
+        else:
+            self._requeue(
+                rec, epoch, reason="dispatch_error", count_attempt=True,
+                error=error,
+            )
+
+    def _requeue(
+        self, rec: _Record, epoch: int, reason: str,
+        count_attempt: bool, error=None,
+    ) -> bool:
+        """Move an in-flight record back to pending — exactly once per
+        dispatch epoch. A record already completed, already requeued, or
+        already re-dispatched to a survivor (epoch moved on) is left
+        alone. Returns True when the record actually went back on the
+        queue."""
+        terminal = None
+        with rec.lock:
+            if rec.state != "inflight" or rec.epoch != epoch:
+                return False
+            if error is not None:
+                rec.last_error = error
+            if count_attempt:
+                rec.attempts += 1
+            now = time.monotonic()
+            if now > rec.deadline:
+                rec.state = "done"
+                terminal = "deadline"
+            elif count_attempt and rec.attempts >= self._max_attempts:
+                rec.state = "done"
+                terminal = "failed"
+            else:
+                rec.state = "pending"
+        if terminal == "deadline":
+            self._deliver_deadline(
+                rec, "deadline expired across dispatch attempts"
+            )
+            return False
+        if terminal == "failed":
+            self._deliver_failed(rec)
+            return False
+        with self._lock:
+            self._counts["requeued"] += 1
+        self._m_requeues.inc(reason=reason)
+        with self._cond:
+            # Front of the queue: a requeued request is the oldest work
+            # in the system; FIFO fairness says it goes next.
+            self._pending.appendleft(rec)
+            self._cond.notify()
+        return True
+
+    # -- terminal deliveries (each guarded: state=="done" exactly once) -------
+
+    def _complete(self, rec: _Record, epoch: int, logits, payload) -> None:
+        with rec.lock:
+            if rec.state != "inflight" or rec.epoch != epoch:
+                return  # a stale win: someone else owns this record now
+            rec.state = "done"
+        end = time.monotonic()
+        with self._lock:
+            self._counts["served"] += 1
+            self._latencies.append(end - rec.submit_t)
+        self._m_requests.inc(outcome="served")
+        # The engine's own e2e rides the future (loadgen computes its
+        # observed-minus-engine overhead from it — now the router+RPC
+        # hop cost instead of the in-process future overhead).
+        rec.future.trace_id = rec.trace_id
+        if payload and payload.get("engine_e2e_s") is not None:
+            rec.future.e2e_latency_s = payload["engine_e2e_s"]
+        self._emit_request_span(rec, end, "served")
+        rec.future.set_result(logits)
+
+    def _deliver_deadline(self, rec: _Record, why: str) -> None:
+        from mpi4dl_tpu.serve.engine import DeadlineExceededError
+
+        with self._lock:
+            self._counts["rejected_deadline"] += 1
+        self._m_requests.inc(outcome="rejected_deadline")
+        self._emit_request_span(rec, time.monotonic(), "rejected_deadline")
+        rec.future.set_exception(DeadlineExceededError(why))
+
+    def _deliver_failed(self, rec: _Record) -> None:
+        with self._lock:
+            self._counts["failed"] += 1
+        self._m_requests.inc(outcome="failed")
+        self._emit_request_span(rec, time.monotonic(), "failed")
+        rec.future.set_exception(FleetRequestError(
+            f"request failed after {rec.attempts} dispatch attempt(s) "
+            f"across replicas {rec.history} (last: {rec.last_error})",
+            attempts=rec.attempts, replicas=rec.history,
+            last_error=rec.last_error,
+        ))
+
+    def _fail(self, rec: _Record, epoch: int, error: Exception) -> None:
+        with rec.lock:
+            if rec.state == "done":
+                return
+            rec.state = "done"
+            rec.last_error = error
+        self._deliver_failed(rec)
+
+    # -- span segments --------------------------------------------------------
+
+    def _emit_dispatch_span(
+        self, rec: _Record, rep: _Replica, t0: float, t1: float, outcome: str
+    ) -> None:
+        """One RPC attempt as a span segment — the hop that makes a
+        requeued request's DEAD-replica attempt visible in trace-export
+        (the dead engine never got to flush its own spans)."""
+        if not self._events.enabled:
+            return
+        self._events.write(telemetry.span_event(
+            "router.dispatch", rec.trace_id,
+            telemetry.spans_from_marks(
+                [("sent", t0), (f"rpc_{rep.name}", max(t1, t0))]
+            ),
+            attrs={
+                "pid": os.getpid(), "role": "router",
+                "replica": rep.name, "attempt": len(rec.history),
+                "outcome": outcome,
+            },
+        ))
+
+    def _emit_request_span(self, rec: _Record, end: float, outcome: str):
+        if not self._events.enabled:
+            return
+        route_t = rec.first_dispatch_t
+        marks = [("submit", rec.submit_t)]
+        if route_t is not None and route_t <= end:
+            marks.append(("route_queue", route_t))
+        marks.append(("dispatch", max(end, rec.submit_t)))
+        self._events.write(telemetry.span_event(
+            "router.request", rec.trace_id,
+            telemetry.spans_from_marks(marks),
+            attrs={
+                "pid": os.getpid(), "role": "router", "outcome": outcome,
+                "attempts": len(rec.history), "replicas": rec.history,
+                "e2e_latency_s": end - rec.submit_t,
+            },
+        ))
+
+    # -- health scraping ------------------------------------------------------
+
+    def _scrape_loop(self) -> None:
+        while not self._scrape_stop.wait(self._health_interval_s):
+            self._scrape_once()
+
+    def _scrape_once(self) -> None:
+        healthy = 0
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            payload, reachable = None, False
+            try:
+                with urllib.request.urlopen(
+                    rep.health_url, timeout=self._scrape_timeout_s
+                ) as resp:
+                    payload = json.loads(resp.read().decode())
+                reachable = True
+            except urllib.error.HTTPError as e:
+                # 503 is a VALID answer: reachable but unhealthy.
+                reachable = True
+                try:
+                    payload = json.loads(e.read().decode())
+                except Exception:  # noqa: BLE001 — body is advisory
+                    payload = {"healthy": False}
+            except Exception:  # noqa: BLE001 — down/black-holed probe
+                rep.scrape_failures += 1
+                if rep.scrape_failures >= 2:
+                    rep.healthy = False
+            if reachable:
+                rep.scrape_failures = 0
+                rep.healthy = bool(payload.get("healthy"))
+                if payload.get("queue_depth") is not None:
+                    rep.queue_depth = float(payload["queue_depth"])
+            if rep.healthy and not rep.removed:
+                healthy += 1
+        self._m_replicas.set(healthy, state="healthy")
+        with self._cond:
+            self._cond.notify_all()

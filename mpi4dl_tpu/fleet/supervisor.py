@@ -1,0 +1,572 @@
+"""Fleet supervisor: reconcile N replica processes toward the desired count.
+
+:func:`mpi4dl_tpu.elastic.supervise` babysits ONE process; this
+generalizes it to a fleet. A daemon reconcile loop ticks every
+``reconcile_interval_s`` and drives each replica slot's state machine::
+
+    starting ──ready──▶ running ──death/wedge/503──▶ backoff ──▶ starting
+        │                   │                           │
+        │                   └──scale-down──▶ draining   └─K failures/window─▶
+        └──exit/timeout──▶ backoff              │            circuit_open
+                                                ▼                (paged)
+                                             stopped
+
+- **Deaths** (process exit, heartbeat loss beyond
+  ``heartbeat_timeout_s``, ``/healthz`` 503 or unreachable for
+  ``unhealthy_after`` straight probes) are remedied the only way a
+  single-controller JAX process can be: kill what's left, requeue the
+  victim's in-flight work through the router
+  (:meth:`Router.remove_replica` — supervisor-confirmed death is the
+  one safe moment to requeue), and respawn with exponential backoff +
+  full jitter (:func:`elastic.full_jitter_backoff`).
+- **Circuit breaker**: ``breaker_max_restarts`` failures within
+  ``breaker_window_s`` (:class:`elastic.RestartBreaker`) flips the slot
+  to ``circuit_open`` — no more respawns, traffic sheds to survivors —
+  and pages through the existing :class:`telemetry.AlertState`
+  machinery (``alert_active{alert="fleet_circuit_<slot>"}`` +
+  ``alert.transition`` events), the same surface every other page in
+  this stack rides.
+- **Desired count**: a static target, or — with ``federation=`` (an
+  :class:`telemetry.SLOConfig`) — the fleet-wide
+  ``autoscale_desired_replicas`` gauge computed by a
+  :class:`~mpi4dl_tpu.telemetry.federation.FederatedAggregator` over
+  the replicas' ``/snapshotz`` endpoints: the PR-5/6 advisory signal,
+  finally actuated. Scale-down drains: stop admissions to the victim
+  (router-side), flush its in-flight ledger, then SIGTERM (the worker
+  serves its queue and exits 0; drained requests are a lifecycle
+  outcome, not an availability failure).
+
+Every restart decision lands as the same schema-valid
+``elastic.restart`` JSONL event the single-process supervisor emits.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from mpi4dl_tpu import elastic, telemetry
+from mpi4dl_tpu.fleet.replica import ReplicaClient, ReplicaProcess
+
+SUPERVISOR_METRICS = (
+    "fleet_replicas",
+    "fleet_replica_restarts_total",
+    "fleet_recovery_seconds",
+)
+
+
+class _Slot:
+    """One replica slot (stable name across incarnations)."""
+
+    def __init__(self, name: str, index: int, breaker):
+        self.name = name
+        self.index = index
+        self.proc: "ReplicaProcess | None" = None
+        self.state = "new"
+        self.breaker = breaker
+        self.attempt = 0          # consecutive failed incarnations
+        self.respawn_at = 0.0
+        self.unhealthy_streak = 0
+        self.death_t: "float | None" = None
+        self.last_reason: "str | None" = None
+        self.ports: "dict | None" = None
+        self.alert = telemetry.AlertState(
+            f"fleet_circuit_{name}", "page", for_s=0.0
+        )
+
+    @property
+    def pid(self) -> "int | None":
+        return self.proc.pid if self.proc is not None else None
+
+    def kill_hard(self) -> None:
+        if self.proc is not None:
+            self.proc.kill_hard()
+
+    @property
+    def client(self) -> "ReplicaClient | None":
+        if self.ports is None:
+            return None
+        return ReplicaClient(
+            self.name,
+            f"http://127.0.0.1:{self.ports['predict_port']}",
+        )
+
+    def view(self) -> dict:
+        return {
+            "name": self.name,
+            "state": self.state,
+            "pid": self.pid,
+            "attempt": self.attempt,
+            "last_reason": self.last_reason,
+            "breaker": self.breaker.state(),
+            "ports": self.ports,
+        }
+
+
+class FleetSupervisor:
+    """Spawn, watch, replace, and scale a fleet of replica workers.
+
+    worker_args: extra argv for ``python -m mpi4dl_tpu.fleet.worker``
+        (model size, watchdog knobs, telemetry dir...).
+    router: the :class:`~mpi4dl_tpu.fleet.router.Router` to wire
+        replicas into (None = supervision without dispatch — useful in
+        drills/tests).
+    registry: metrics registry; defaults to the router's so one scrape
+        shows admission, dispatch, and supervision together.
+    replicas: initial/static desired count (also the autoscale floor
+        when ``federation`` is set, unless its config says otherwise).
+    max_replicas: autoscale ceiling (static mode: a hard clamp).
+    federation: a :class:`telemetry.SLOConfig` — runs a
+        :class:`FederatedAggregator` over the replicas and follows its
+        fleet-wide ``autoscale_desired_replicas`` gauge. None = static.
+    heartbeat_timeout_s: staleness beyond this kills + replaces (None
+        disables; the worker's beats are health-gated, so a wedged
+        batcher goes stale even while its process looks alive).
+    unhealthy_after: consecutive failed/503 ``/healthz`` probes before
+        kill + replace.
+    backoff_base_s / backoff_max_s: respawn backoff (full jitter).
+    breaker_max_restarts / breaker_window_s: per-slot circuit breaker.
+    events / flight: ``elastic.restart`` + ``alert.transition`` sinks.
+    """
+
+    def __init__(
+        self,
+        worker_args: "list[str]",
+        router=None,
+        registry=None,
+        base_dir: "str | None" = None,
+        replicas: int = 1,
+        max_replicas: "int | None" = None,
+        federation=None,
+        env: "dict | None" = None,
+        reconcile_interval_s: float = 0.25,
+        heartbeat_timeout_s: "float | None" = 5.0,
+        unhealthy_after: int = 4,
+        scrape_timeout_s: float = 1.0,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 10.0,
+        breaker_max_restarts: int = 3,
+        breaker_window_s: float = 60.0,
+        spawn_timeout_s: float = 600.0,
+        drain_timeout_s: float = 10.0,
+        events=None,
+        flight=None,
+        clock=time.monotonic,
+    ):
+        import tempfile
+
+        from mpi4dl_tpu.fleet.replica import worker_cmd
+
+        self.router = router
+        self.registry = (
+            registry if registry is not None
+            else (router.registry if router is not None
+                  else telemetry.MetricsRegistry())
+        )
+        self._worker_cmd = worker_cmd(worker_args)
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="mpi4dl-fleet-")
+        self._env = dict(env if env is not None else os.environ)
+        self._interval = float(reconcile_interval_s)
+        self._hb_timeout = heartbeat_timeout_s
+        self._unhealthy_after = int(unhealthy_after)
+        self._scrape_timeout_s = float(scrape_timeout_s)
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_max_s = float(backoff_max_s)
+        self._breaker_max = int(breaker_max_restarts)
+        self._breaker_window_s = float(breaker_window_s)
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._events = events if events is not None else (
+            router.events if router is not None else None
+        )
+        self._flight = flight
+        self._clock = clock
+        self._static_desired = int(replicas)
+        self._max_replicas = (
+            int(max_replicas) if max_replicas is not None else int(replicas)
+        )
+
+        self._m_replicas = telemetry.declare(self.registry, "fleet_replicas")
+        self._m_restarts = telemetry.declare(
+            self.registry, "fleet_replica_restarts_total"
+        )
+        self._m_recovery = telemetry.declare(
+            self.registry, "fleet_recovery_seconds"
+        )
+        self._m_alert = telemetry.declare(self.registry, "alert_active")
+
+        self._lock = threading.RLock()
+        self._slots: "dict[str, _Slot]" = {}
+        self.restarts = 0
+        self.last_recovery_s: "float | None" = None
+
+        self.aggregator = None
+        if federation is not None:
+            from mpi4dl_tpu.telemetry.federation import FederatedAggregator
+
+            self.aggregator = FederatedAggregator(
+                registry=self.registry,
+                slo=federation,
+                interval_s=max(0.25, self._interval),
+                timeout_s=self._scrape_timeout_s,
+            )
+
+        self._stop_evt = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    # -- public surface -------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            for i in range(self._static_desired):
+                self._ensure_slot(i)
+        if self.aggregator is not None:
+            self.aggregator.start()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="mpi4dl-fleet-supervisor", daemon=True
+            )
+            self._thread.start()
+
+    def wait_ready(self, timeout_s: float = 600.0) -> None:
+        """Block until the fleet reaches the desired running count (the
+        CLI's before-load barrier)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.running_count() >= self.desired_replicas():
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"fleet not ready within {timeout_s:.0f}s: "
+            f"{self.running_count()}/{self.desired_replicas()} running"
+        )
+
+    def running_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for s in self._slots.values() if s.state == "running"
+            )
+
+    def desired_replicas(self) -> int:
+        """The reconcile target: the fleet-wide autoscale gauge when
+        federated (the PR-5 advisory signal, actuated), else the static
+        count; clamped to ``[1, max_replicas]``."""
+        desired = None
+        if self.aggregator is not None:
+            m = self.aggregator.registry.get("autoscale_desired_replicas")
+            if m is not None:
+                desired = m.value()
+        if desired is None:
+            desired = self._static_desired
+        return max(1, min(int(desired), self._max_replicas))
+
+    def slot_by_index(self, index: int) -> "_Slot | None":
+        with self._lock:
+            for s in self._slots.values():
+                if s.index == index:
+                    return s
+        return None
+
+    def state(self) -> dict:
+        with self._lock:
+            slots = [s.view() for s in self._slots.values()]
+        return {
+            "desired": self.desired_replicas(),
+            "running": self.running_count(),
+            "restarts": self.restarts,
+            "last_recovery_s": self.last_recovery_s,
+            "slots": slots,
+        }
+
+    def close(self, terminate: bool = True) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self.aggregator is not None:
+            self.aggregator.close()
+        if terminate:
+            with self._lock:
+                slots = list(self._slots.values())
+            for s in slots:
+                if s.proc is not None and s.proc.alive():
+                    s.proc.terminate(wait_s=self._drain_timeout_s)
+
+    # -- slot lifecycle -------------------------------------------------------
+
+    def _ensure_slot(self, index: int) -> _Slot:
+        name = f"r{index}"
+        slot = self._slots.get(name)
+        if slot is None:
+            slot = _Slot(name, index, elastic.RestartBreaker(
+                self._breaker_max, window_s=self._breaker_window_s,
+                clock=self._clock,
+            ))
+            self._slots[name] = slot
+        if slot.state in ("new", "stopped"):
+            self._spawn(slot)
+        return slot
+
+    def _spawn(self, slot: _Slot) -> None:
+        hb = os.path.join(self.base_dir, f"{slot.name}.heartbeat")
+        slot.proc = ReplicaProcess(
+            slot.name, self._worker_cmd, self.base_dir,
+            env=self._env, heartbeat_path=hb,
+            log_path=os.path.join(self.base_dir, f"{slot.name}.log"),
+        )
+        slot.proc.spawn()
+        slot.state = "starting"
+        slot.ports = None
+        slot.unhealthy_streak = 0
+
+    def _on_ready(self, slot: _Slot, ports: dict) -> None:
+        slot.ports = ports
+        slot.state = "running"
+        slot.attempt = 0
+        predict_url = f"http://127.0.0.1:{ports['predict_port']}"
+        metrics_url = f"http://127.0.0.1:{ports['metrics_port']}"
+        if self.router is not None:
+            self.router.add_replica(
+                slot.name, predict_url, health_url=metrics_url
+            )
+        if self.aggregator is not None:
+            self.aggregator.add_replica(slot.name, metrics_url)
+        if slot.death_t is not None:
+            # Death-to-replacement-serving: the fleet's recovery latency
+            # (bench-trended via the fleet_2replica extra).
+            self.last_recovery_s = self._clock() - slot.death_t
+            self._m_recovery.set(self.last_recovery_s)
+            slot.death_t = None
+
+    def _on_death(self, slot: _Slot, reason: str, kind: str) -> None:
+        """A confirmed-dead incarnation: requeue its work, count it,
+        decide between backoff-respawn and tripping the breaker."""
+        now = self._clock()
+        self.restarts += 1
+        slot.last_reason = reason
+        if slot.death_t is None:
+            slot.death_t = now
+        if self.router is not None:
+            # The process is gone (exited or just SIGKILLed): requeueing
+            # its ledger cannot double-execute.
+            self.router.remove_replica(slot.name, requeue=True)
+        if self.aggregator is not None:
+            self.aggregator.remove_replica(slot.name)
+        self._m_restarts.inc(replica=slot.name, reason=kind)
+        slot.breaker.record_failure()
+        slot.attempt += 1
+        if slot.breaker.allow():
+            backoff = elastic.full_jitter_backoff(
+                slot.attempt, base_s=self._backoff_base_s,
+                max_s=self._backoff_max_s,
+            )
+            slot.respawn_at = now + backoff
+            slot.state = "backoff"
+        else:
+            backoff = 0.0
+            slot.state = "circuit_open"
+        elastic.restart_event(
+            slot.attempt, backoff, reason,
+            events=self._events, flight=self._flight,
+            replica=slot.name, circuit_open=slot.state == "circuit_open",
+        )
+        self._step_alert(slot, now)
+
+    def _step_alert(self, slot: _Slot, now: float) -> None:
+        """The circuit-open page rides the stock AlertState machinery:
+        alert_active gauge + alert.transition events — one /alertz-shaped
+        runbook for burn alerts, memory pages, and fleet pages alike."""
+        moved = slot.alert.step(slot.state == "circuit_open", now)
+        self._m_alert.set(
+            1.0 if slot.alert.state == "firing" else 0.0,
+            alert=slot.alert.name, severity=slot.alert.severity,
+        )
+        if moved is None:
+            return
+        ev = {
+            "ts": time.time(),
+            "kind": "event",
+            "name": "alert.transition",
+            "attrs": {
+                "alert": slot.alert.name,
+                "severity": slot.alert.severity,
+                "from": moved[0],
+                "to": moved[1],
+                "replica": slot.name,
+                "reason": slot.last_reason,
+                "breaker": slot.breaker.state(),
+            },
+        }
+        if self._flight is not None:
+            self._flight.record(ev)
+        if self._events is not None and getattr(self._events, "enabled", False):
+            self._events.write(ev)
+
+    def reset_breaker(self, name: str) -> None:
+        """Operator override: close a slot's circuit and let the next
+        reconcile tick respawn it."""
+        with self._lock:
+            slot = self._slots.get(name)
+            if slot is None:
+                return
+            slot.breaker.reset()
+            slot.attempt = 0
+            if slot.state == "circuit_open":
+                slot.state = "backoff"
+                slot.respawn_at = self._clock()
+            self._step_alert(slot, self._clock())
+
+    # -- health probing -------------------------------------------------------
+
+    def _probe_unhealthy(self, slot: _Slot) -> bool:
+        """One supervisor-side ``/healthz`` probe: True when the replica
+        answered 503 or didn't answer (black-holed probes count — the
+        timeout IS the signal)."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        if slot.ports is None:
+            return False
+        url = (
+            f"http://127.0.0.1:{slot.ports['metrics_port']}/healthz"
+        )
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self._scrape_timeout_s
+            ) as resp:
+                return not json.loads(resp.read().decode()).get("healthy")
+        except urllib.error.HTTPError:
+            return True   # 503: reachable and saying NO
+        except Exception:  # noqa: BLE001 — unreachable/black-holed
+            return True
+
+    # -- reconcile loop -------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the reconciler must
+                pass  # outlive any single bad tick
+
+    def _tick(self) -> None:
+        now = self._clock()
+        with self._lock:
+            slots = list(self._slots.values())
+        for slot in slots:
+            if slot.state == "running":
+                self._check_running(slot, now)
+            elif slot.state == "starting":
+                self._check_starting(slot, now)
+            elif slot.state == "backoff" and now >= slot.respawn_at:
+                self._spawn(slot)
+        self._reconcile_count()
+        self._publish_gauges()
+
+    def _check_running(self, slot: _Slot, now: float) -> None:
+        if not slot.proc.alive():
+            self._on_death(
+                slot, f"process exited rc={slot.proc.returncode}", "exit"
+            )
+            return
+        if self._hb_timeout:
+            stale = slot.proc.heartbeat_stale_s()
+            if stale is not None and stale > self._hb_timeout:
+                slot.proc.kill_hard()
+                self._on_death(
+                    slot,
+                    f"heartbeat stale {stale:.1f}s (> {self._hb_timeout}s)",
+                    "heartbeat",
+                )
+                return
+        if self._probe_unhealthy(slot):
+            slot.unhealthy_streak += 1
+            if slot.unhealthy_streak >= self._unhealthy_after:
+                slot.proc.kill_hard()
+                self._on_death(
+                    slot,
+                    f"/healthz unhealthy x{slot.unhealthy_streak}",
+                    "unhealthy",
+                )
+        else:
+            slot.unhealthy_streak = 0
+
+    def _check_starting(self, slot: _Slot, now: float) -> None:
+        ports = slot.proc.poll_ready()
+        if ports is not None:
+            self._on_ready(slot, ports)
+        elif not slot.proc.alive():
+            self._on_death(
+                slot,
+                f"exited during start rc={slot.proc.returncode}", "exit",
+            )
+        elif now - slot.proc.spawned_at > self._spawn_timeout_s:
+            slot.proc.kill_hard()
+            self._on_death(slot, "start timeout", "exit")
+
+    def _reconcile_count(self) -> None:
+        desired = self.desired_replicas()
+        with self._lock:
+            active = [
+                s for s in self._slots.values()
+                if s.state in ("starting", "running", "backoff", "draining")
+            ]
+            if len(active) < desired:
+                # Fill the lowest free indexes (stable names).
+                used = {s.index for s in active}
+                i = 0
+                while len(active) < desired:
+                    if i not in used or self._slots.get(f"r{i}") is None \
+                            or self._slots[f"r{i}"].state in ("new", "stopped"):
+                        slot = self._ensure_slot(i)
+                        if slot not in active:
+                            active.append(slot)
+                        used.add(i)
+                    i += 1
+                    if i > self._max_replicas + len(self._slots):
+                        break  # everything else is circuit_open
+            elif len(active) > desired:
+                # Scale down: drain the highest-index running replicas.
+                excess = len(active) - desired
+                victims = sorted(
+                    (s for s in active if s.state == "running"),
+                    key=lambda s: -s.index,
+                )[:excess]
+                for slot in victims:
+                    slot.state = "draining"
+                    threading.Thread(
+                        target=self._drain_and_stop, args=(slot,),
+                        name=f"mpi4dl-fleet-drain-{slot.name}", daemon=True,
+                    ).start()
+
+    def _drain_and_stop(self, slot: _Slot) -> None:
+        """Scale-down drain: stop admissions (router-side), flush the
+        in-flight ledger, SIGTERM (the worker drains its engine queue
+        and exits 0), then deregister."""
+        if self.router is not None:
+            self.router.drain_replica(
+                slot.name, timeout_s=self._drain_timeout_s
+            )
+        if slot.proc is not None:
+            slot.proc.terminate(wait_s=self._drain_timeout_s)
+        if self.router is not None:
+            # Ledger flushed (or timed out) and the process is gone;
+            # anything left requeues rather than hangs.
+            self.router.remove_replica(slot.name, requeue=True)
+        if self.aggregator is not None:
+            self.aggregator.remove_replica(slot.name)
+        slot.ports = None
+        slot.state = "stopped"
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            by_state: "dict[str, int]" = {}
+            for s in self._slots.values():
+                by_state[s.state] = by_state.get(s.state, 0) + 1
+        self._m_replicas.set(self.desired_replicas(), state="desired")
+        for state in ("running", "starting", "backoff", "draining",
+                      "circuit_open"):
+            self._m_replicas.set(by_state.get(state, 0), state=state)

@@ -1,0 +1,346 @@
+"""Replica worker: one ServingEngine + predict HTTP server, per process.
+
+``python -m mpi4dl_tpu.fleet.worker --ready-file /run/r0.ready.json``
+builds a synthetic calibrated model (the same zero-artifact path as
+``python -m mpi4dl_tpu.serve``), AOT-warms the engine, then serves:
+
+- ``POST /predict`` — blocking predict RPC (base64 float bytes in/out;
+  the router's :class:`~mpi4dl_tpu.fleet.replica.ReplicaClient` is the
+  other side). Engine admission failures map to structured HTTP errors:
+  429 queue-full (with the engine's ``retry_after_s`` cadence hint),
+  504 deadline, 503 draining.
+- ``POST /chaos`` — the fault-injection surface
+  (:mod:`mpi4dl_tpu.fleet.chaos`): ``wedge`` blocks the batcher's
+  dispatch mid-loop (submit path and HTTP threads stay alive — the
+  wedged-but-alive shape only the watchdog-gated heartbeat exposes),
+  ``blackhole_healthz`` makes ``/healthz`` hang, ``delay_scrape`` adds
+  latency to ``/snapshotz``, ``unwedge`` recovers.
+- the standard telemetry surface (``/metrics``, ``/snapshotz``,
+  ``/healthz``, ``/debugz``) — built HERE rather than via
+  ``metrics_port=`` so the chaos hooks can wrap the health callable and
+  registry, and so ``/healthz`` carries the live ``queue_depth`` +
+  ``draining`` fields the router's one-endpoint scrape reads.
+
+Ready handshake: once everything is up, the ports land atomically in
+``--ready-file`` (``os.replace`` — a partially-written handshake can
+never be read). Supervision: the spawning fleet supervisor sets
+``MPI4DL_TPU_HEARTBEAT``; the health-gated
+:class:`~mpi4dl_tpu.elastic.HeartbeatReporter` goes silent when the
+watchdog trips, which is how a wedged batcher gets this process killed
+and replaced. SIGTERM drains: stop admissions (503), flush in-flight,
+exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.fleet.worker",
+        description="mpi4dl_tpu fleet replica worker (one engine, one chip)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--ready-file", required=True,
+                   help="JSON handshake file written (atomically) once "
+                        "the engine is warm and the ports are bound")
+    p.add_argument("--port", type=int, default=0,
+                   help="predict endpoint port (0 = ephemeral)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="telemetry endpoint port (0 = ephemeral)")
+    p.add_argument("--image-size", type=int, default=16)
+    p.add_argument("--depth", type=int, default=None,
+                   help="synthetic ResNet-v2 depth (9n+2); default tiny")
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--max-batch", type=int, default=2)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--default-deadline-s", type=float, default=30.0)
+    p.add_argument("--watchdog-factor", type=float, default=20.0)
+    p.add_argument("--watchdog-min-timeout", type=float, default=2.0,
+                   help="floor of the stall detector — drills shrink it "
+                        "so a wedge is declared fast")
+    p.add_argument("--telemetry-dir", default=None)
+    return p
+
+
+class _ChaosState:
+    """The worker-side fault switches the /chaos endpoint flips."""
+
+    def __init__(self):
+        self.wedged = threading.Event()
+        self.blackhole_healthz = False
+        self.scrape_delay_s = 0.0
+
+    def apply(self, action: str, seconds: float = 0.0) -> dict:
+        if action == "wedge":
+            self.wedged.set()
+        elif action == "unwedge":
+            self.wedged.clear()
+        elif action == "blackhole_healthz":
+            self.blackhole_healthz = True
+        elif action == "delay_scrape":
+            self.scrape_delay_s = float(seconds)
+        else:
+            raise ValueError(f"unknown chaos action {action!r}")
+        return {"ok": True, "applied": action}
+
+    def gate_dispatch(self) -> None:
+        """Called inside the batcher's dispatch: while wedged, block —
+        the loop thread hangs exactly like a stuck device call, while
+        every other thread in the process stays alive."""
+        while self.wedged.is_set():
+            time.sleep(0.05)
+
+
+class _DelayedRegistry:
+    """Registry proxy whose snapshot() honors the delay-scrape drill —
+    slow telemetry must slow the FEDERATION view (scrape timeouts,
+    stale merges), never the serving path, which keeps writing to the
+    real registry underneath."""
+
+    def __init__(self, registry, chaos: _ChaosState):
+        self._registry = registry
+        self._chaos = chaos
+
+    def snapshot(self):
+        if self._chaos.scrape_delay_s > 0:
+            time.sleep(self._chaos.scrape_delay_s)
+        return self._registry.snapshot()
+
+    def __getattr__(self, name):
+        return getattr(self._registry, name)
+
+
+def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
+                    port: int) -> ThreadingHTTPServer:
+    from mpi4dl_tpu.serve.engine import (
+        DeadlineExceededError,
+        DrainedError,
+        QueueFullError,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length).decode())
+                if self.path == "/predict":
+                    self._predict(req)
+                elif self.path == "/chaos":
+                    self._reply(200, chaos.apply(
+                        req["action"], req.get("seconds", 0.0)
+                    ))
+                else:
+                    self._reply(404, {"ok": False, "error": "not found"})
+            except BrokenPipeError:
+                pass  # client gone (a killed router): nothing to answer
+            except Exception as e:  # noqa: BLE001 — one bad request must
+                # not kill the handler thread pool
+                try:
+                    self._reply(500, {
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def _predict(self, req: dict) -> None:
+            if draining.is_set():
+                self._reply(503, {"ok": False, "error": "draining"})
+                return
+            x = np.frombuffer(
+                base64.b64decode(req["x_b64"]), dtype=req.get(
+                    "dtype", "float32"
+                )
+            ).reshape(req["shape"])
+            try:
+                fut = engine.submit(
+                    x,
+                    deadline_s=req.get("deadline_s"),
+                    trace_id=req.get("trace_id"),
+                )
+            except QueueFullError as e:
+                self._reply(429, {
+                    "ok": False, "error": "queue_full",
+                    "retry_after_s": e.retry_after_s,
+                })
+                return
+            try:
+                # The engine enforces the deadline; +5s grace means a
+                # late result still surfaces as the engine's own typed
+                # outcome rather than a worker-side timeout guess.
+                logits = fut.result(
+                    timeout=(req.get("deadline_s") or 30.0) + 5.0
+                )
+            except DeadlineExceededError as e:
+                self._reply(504, {"ok": False, "error": f"deadline: {e}"})
+                return
+            except DrainedError as e:
+                self._reply(503, {"ok": False, "error": f"drained: {e}"})
+                return
+            except Exception as e:  # noqa: BLE001 — engine-side failure
+                self._reply(500, {
+                    "ok": False, "error": f"{type(e).__name__}: {e}",
+                })
+                return
+            logits = np.asarray(logits)
+            self._reply(200, {
+                "ok": True,
+                "logits_b64": base64.b64encode(logits.tobytes()).decode(),
+                "dtype": str(logits.dtype),
+                "shape": list(logits.shape),
+                "trace_id": getattr(fut, "trace_id", req.get("trace_id")),
+                "engine_e2e_s": getattr(fut, "e2e_latency_s", None),
+                "pid": os.getpid(),
+            })
+
+        def log_message(self, *a):  # RPC traffic must not spam stderr
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(
+        target=httpd.serve_forever, name="mpi4dl-replica-predict",
+        daemon=True,
+    ).start()
+    return httpd
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from mpi4dl_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu import elastic, telemetry
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.utils import get_depth
+
+    size = args.image_size
+    depth = args.depth if args.depth is not None else get_depth(2, 1)
+    cells = get_resnet_v2(
+        depth=depth, num_classes=args.classes, pool_kernel=size // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+    engine = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3),
+        max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
+        max_queue=args.max_queue,
+        default_deadline_s=args.default_deadline_s,
+        telemetry_dir=args.telemetry_dir,
+        watchdog_factor=args.watchdog_factor or None,
+        watchdog_min_timeout_s=args.watchdog_min_timeout,
+    )
+
+    chaos = _ChaosState()
+    # Chaos seam: the wedge gate runs INSIDE the batcher thread's
+    # dispatch, upstream of the real one — a wedged batcher with live
+    # submit/HTTP/heartbeat threads, which is the failure shape the
+    # health-gated heartbeat exists to expose.
+    orig_dispatch = engine._dispatch
+
+    def gated_dispatch(reqs):
+        chaos.gate_dispatch()
+        return orig_dispatch(reqs)
+
+    engine._dispatch = gated_dispatch
+
+    draining = threading.Event()
+
+    def health_payload() -> dict:
+        if chaos.blackhole_healthz:
+            time.sleep(3600)  # the probe black-hole drill
+        snap = dict(engine.health.snapshot())
+        snap["queue_depth"] = engine._q.qsize()
+        snap["draining"] = draining.is_set()
+        snap["pid"] = os.getpid()
+        return snap
+
+    metrics_server = telemetry.MetricsServer(
+        _DelayedRegistry(engine.registry, chaos),
+        port=args.metrics_port,
+        health=health_payload,
+        debug=engine._debugz,
+        alerts=engine.slo.state if engine.slo is not None else None,
+    )
+    predict_httpd = _predict_server(engine, chaos, draining, args.port)
+
+    heartbeat = None
+    hb_path = elastic.heartbeat_path_from_env()
+    if hb_path:
+        heartbeat = elastic.HeartbeatReporter(
+            hb_path, health=engine.health, watchdog=engine.watchdog,
+            interval_s=0.2,
+        )
+        heartbeat.start()
+
+    engine.start()
+
+    stop_evt = threading.Event()
+
+    def _sigterm(signum, frame):  # noqa: ARG001 — signal API
+        draining.set()
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+
+    ready = {
+        "pid": os.getpid(),
+        "predict_port": predict_httpd.server_address[1],
+        "metrics_port": metrics_server.port,
+    }
+    tmp = args.ready_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ready, f)
+    os.replace(tmp, args.ready_file)
+    print(f"# replica ready: {json.dumps(ready)}", file=sys.stderr,
+          flush=True)
+
+    stop_evt.wait()
+    # Graceful drain: admissions already answer 503; serve what's
+    # queued, then tear down.
+    engine.stop(drain=True)
+    predict_httpd.shutdown()
+    metrics_server.close()
+    if heartbeat is not None:
+        heartbeat.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,7 @@ from mpi4dl_tpu.serve.batching import (  # noqa: F401
 )
 from mpi4dl_tpu.serve.engine import (  # noqa: F401
     DeadlineExceededError,
+    DrainedError,
     QueueFullError,
     ServingEngine,
 )

@@ -56,6 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="open loop: offered requests/sec")
     p.add_argument("--duration", type=float, default=5.0,
                    help="open loop: seconds")
+    p.add_argument("--queue-full-retries", type=int, default=0,
+                   help="opt-in client retries per request on queue-full "
+                        "admission bounces, backing off per the engine's "
+                        "retry_after_s cadence hint (0 = shed instantly)")
+    p.add_argument("--retry-backoff-ms", type=float, default=None,
+                   help="explicit retry backoff base; default honors the "
+                        "engine's QueueFullError.retry_after_s hint")
     p.add_argument("--serial", type=int, default=16,
                    help="batch-size-1 serial baseline requests (0 skips)")
     p.add_argument("--lint", action="store_true",
@@ -264,17 +271,24 @@ def main(argv=None) -> int:
     try:
         with profiler_trace(args.trace_dir) if args.trace_dir \
                 else nullcontext():
+            retry_kw = {
+                "queue_full_retries": args.queue_full_retries,
+                "retry_backoff_s": (
+                    args.retry_backoff_ms / 1e3
+                    if args.retry_backoff_ms is not None else None
+                ),
+            }
             if args.mode == "closed":
                 report["loadgen"] = run_closed_loop(
                     engine, args.requests, concurrency=args.concurrency,
                     deadline_s=args.deadline_ms / 1e3,
-                    events=engine.events,
+                    events=engine.events, **retry_kw,
                 )
             else:
                 report["loadgen"] = run_open_loop(
                     engine, rate_rps=args.rate, duration_s=args.duration,
                     deadline_s=args.deadline_ms / 1e3,
-                    events=engine.events,
+                    events=engine.events, **retry_kw,
                 )
     finally:
         engine.stop()

@@ -73,11 +73,30 @@ from mpi4dl_tpu.serve.batching import bucket_for, pad_batch, power_of_two_bucket
 
 
 class QueueFullError(RuntimeError):
-    """Admission control: the bounded request queue is full."""
+    """Admission control: the bounded request queue is full.
+
+    retry_after_s: advisory backoff hint derived from the live batch
+        cadence (one batch drains up to ``max_batch`` queue slots per
+        period, so a slot frees within roughly one period) — a client
+        that waits this long before retrying lands when room plausibly
+        exists instead of hammering a full queue. None when the engine
+        has no cadence estimate yet (nothing served)."""
+
+    def __init__(self, msg: str, retry_after_s: "float | None" = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceededError(TimeoutError):
     """The request's deadline passed before a result could be delivered."""
+
+
+class DrainedError(RuntimeError):
+    """The request was flushed by a deliberate stop/drain — an
+    operator- or router-initiated lifecycle event, not a serving
+    failure. Counted as ``outcome="drained"`` (excluded from the
+    availability SLO) so a fleet scale-down does not burn error budget;
+    a router catching this requeues the request on a survivor."""
 
 
 @dataclasses.dataclass
@@ -310,9 +329,14 @@ class ServingEngine:
             "rejected_deadline": 0,
             "served": 0,
             "served_late": 0,
+            "drained": 0,
             "batches": 0,
             "batched_examples": 0,
         }
+        # Batch-completion cadence (EMA of the gap between completed
+        # batches) — the QueueFullError.retry_after_s hint's source.
+        self._batch_period_ema: "float | None" = None
+        self._last_complete_t: "float | None" = None
         self._latencies: list[float] = []
         self._bucket_dispatches: dict[int, int] = {b: 0 for b in self._buckets}
         self._padded_rows = 0
@@ -486,7 +510,9 @@ class ServingEngine:
 
     def stop(self, drain: bool = True) -> None:
         """Stop the batcher. ``drain=True`` serves what is already queued
-        first; ``drain=False`` fails queued requests immediately."""
+        first; ``drain=False`` fails queued requests immediately with
+        :class:`DrainedError` (counted ``outcome="drained"`` — a
+        lifecycle event, not an availability-SLO failure)."""
         if not drain:
             self._flush_queue("engine stopped before this request was served")
         self._stop_evt.set()
@@ -570,10 +596,22 @@ class ServingEngine:
                 self._counts["rejected_queue_full"] += 1
             self._m_requests.inc(outcome="rejected_queue_full")
             raise QueueFullError(
-                f"request queue full ({self._q.maxsize} waiting)"
+                f"request queue full ({self._q.maxsize} waiting)",
+                retry_after_s=self.retry_after_hint(),
             ) from None
         self._m_qdepth.set(self._q.qsize())
         return req.future
+
+    def retry_after_hint(self) -> float:
+        """How long a queue-full-rejected client should wait before
+        retrying: one batch-completion period (EMA), floored at the
+        batch-formation window. Before the first completed batch the
+        warm latency stands in — the engine's only cadence fact."""
+        with self._lock:
+            ema = self._batch_period_ema
+        if ema is None:
+            ema = max(self.warm_latency_s.values())
+        return max(self._max_wait_s, ema)
 
     def predict_one(self, x) -> np.ndarray:
         """Synchronous batch-size-1 forward through the bucket-1
@@ -722,7 +760,7 @@ class ServingEngine:
                 self.flight.dump(reason="crash")
             except Exception:  # noqa: BLE001 — postmortem best-effort
                 pass
-            self._flush_queue(f"batcher crashed: {e!r}")
+            self._flush_queue(f"batcher crashed: {e!r}", outcome=None)
             raise
 
     def _loop_inner(self) -> None:
@@ -907,6 +945,13 @@ class ServingEngine:
         with self._lock:
             self._counts["batches"] += 1
             self._counts["batched_examples"] += len(reqs)
+            if self._last_complete_t is not None:
+                period = now - self._last_complete_t
+                self._batch_period_ema = (
+                    period if self._batch_period_ema is None
+                    else 0.8 * self._batch_period_ema + 0.2 * period
+                )
+            self._last_complete_t = now
         for i, r in enumerate(reqs):
             if self.watchdog is not None:
                 self.watchdog.done(now - r.submit_t)
@@ -996,7 +1041,14 @@ class ServingEngine:
             "deadline expired while the request waited for batch formation"
         ))
 
-    def _flush_queue(self, msg: str) -> None:
+    def _flush_queue(self, msg: str, outcome: "str | None" = "drained") -> None:
+        """Fail every still-queued request. ``outcome="drained"``
+        (deliberate stop/drain) delivers :class:`DrainedError` and
+        counts the distinct ``drained`` label — excluded from the
+        availability SLO, so a router-initiated drain never burns error
+        budget. ``outcome=None`` (batcher crash) keeps the bare
+        RuntimeError: those ARE failures and the crash already
+        surfaced through health/flight."""
         while True:
             try:
                 req = self._q.get_nowait()
@@ -1004,4 +1056,10 @@ class ServingEngine:
                 return
             if self.watchdog is not None:
                 self.watchdog.cancel()
-            req.future.set_exception(RuntimeError(msg))
+            if outcome == "drained":
+                with self._lock:
+                    self._counts["drained"] += 1
+                self._m_requests.inc(outcome="drained")
+                req.future.set_exception(DrainedError(msg))
+            else:
+                req.future.set_exception(RuntimeError(msg))

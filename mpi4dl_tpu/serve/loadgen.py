@@ -94,6 +94,7 @@ class _Tally:
         self.overheads: list[float] = []
         self.served = 0
         self.rejected_queue_full = 0
+        self.queue_full_retries = 0
         self.deadline_misses = 0
         self.errors = 0
         self._events = events
@@ -119,6 +120,12 @@ class _Tally:
         with self.lock:
             self.rejected_queue_full += 1
         self._count("rejected_queue_full")
+
+    def retried(self) -> None:
+        """A queue-full bounce the client absorbed with a backoff-retry
+        (not a terminal outcome — the request is still in play)."""
+        with self.lock:
+            self.queue_full_retries += 1
 
     def resolve(
         self,
@@ -187,6 +194,34 @@ class _Tally:
         ))
 
 
+def _submit_with_retry(
+    engine, x, deadline_s, tid, tally: _Tally,
+    queue_full_retries: int, retry_backoff_s: "float | None",
+):
+    """Submit with opt-in bounded retry on queue-full. Each bounce waits
+    the engine's ``retry_after_s`` cadence hint (or the explicit
+    ``retry_backoff_s``) doubled per attempt — open-loop overload then
+    measures shed-AND-retry behavior (what a real client with a retry
+    policy experiences) instead of counting instant failures. Returns
+    the future, or None when the bounces exhausted the budget (tallied
+    as a terminal rejection)."""
+    attempts = 0
+    while True:
+        try:
+            return engine.submit(x, deadline_s=deadline_s, trace_id=tid)
+        except QueueFullError as e:
+            if attempts >= queue_full_retries:
+                tally.reject()
+                return None
+            base = (
+                retry_backoff_s if retry_backoff_s is not None
+                else (e.retry_after_s or 0.01)
+            )
+            tally.retried()
+            time.sleep(min(base * (2.0 ** attempts), 1.0))
+            attempts += 1
+
+
 def run_closed_loop(
     engine: ServingEngine,
     num_requests: int,
@@ -195,6 +230,8 @@ def run_closed_loop(
     make_example=None,
     registry=None,
     events=None,
+    queue_full_retries: int = 0,
+    retry_backoff_s: "float | None" = None,
 ) -> dict:
     """``concurrency`` clients ping-ponging until ``num_requests`` total
     have been submitted. High concurrency >> max batch keeps the queue
@@ -202,7 +239,9 @@ def run_closed_loop(
     dynamic batching must beat serial bs-1 throughput. ``registry``
     defaults to the engine's own, so client-side metrics share its scrape
     endpoint; ``events`` (a JsonlWriter, e.g. ``engine.events``) adds a
-    ``client.request`` span segment per request to the trace log."""
+    ``client.request`` span segment per request to the trace log.
+    ``queue_full_retries`` (opt-in) bounds per-request backoff-retries on
+    admission bounces, honoring ``QueueFullError.retry_after_s``."""
     from mpi4dl_tpu import telemetry
 
     make_example = make_example or _default_example(engine)
@@ -220,12 +259,11 @@ def run_closed_loop(
                 return
             tid = telemetry.new_trace_id("client")
             t = time.monotonic()
-            try:
-                fut = engine.submit(
-                    make_example(i), deadline_s=deadline_s, trace_id=tid
-                )
-            except QueueFullError:
-                tally.reject()
+            fut = _submit_with_retry(
+                engine, make_example(i), deadline_s, tid, tally,
+                queue_full_retries, retry_backoff_s,
+            )
+            if fut is None:
                 continue
             tally.resolve(fut, t, trace_id=tid, t_submitted=time.monotonic())
 
@@ -248,9 +286,15 @@ def run_open_loop(
     make_example=None,
     registry=None,
     events=None,
+    queue_full_retries: int = 0,
+    retry_backoff_s: "float | None" = None,
 ) -> dict:
     """Fixed-rate arrivals for ``duration_s`` seconds; completions are
-    collected by worker threads so a slow tail never throttles arrivals."""
+    collected by worker threads so a slow tail never throttles arrivals.
+    With ``queue_full_retries`` > 0, admission bounces retry with
+    backoff INSIDE the per-request worker thread — the arrival clock
+    stays open-loop (arrivals never wait on a retry), which is exactly
+    the overload regime where shed-and-retry behavior is measured."""
     from mpi4dl_tpu import telemetry
 
     make_example = make_example or _default_example(engine)
@@ -262,6 +306,15 @@ def run_open_loop(
     n = 0
     t0 = time.perf_counter()
     start = time.monotonic()
+
+    def submit_and_resolve(x, tid, t):
+        fut = _submit_with_retry(
+            engine, x, deadline_s, tid, tally,
+            queue_full_retries, retry_backoff_s,
+        )
+        if fut is not None:
+            tally.resolve(fut, t, trace_id=tid, t_submitted=time.monotonic())
+
     while time.perf_counter() - t0 < duration_s:
         target = start + n * period
         delay = target - time.monotonic()
@@ -270,6 +323,14 @@ def run_open_loop(
         tid = telemetry.new_trace_id("client")
         t = time.monotonic()
         n += 1
+        if queue_full_retries > 0:
+            # Retries sleep; they must do so off the arrival clock.
+            w = threading.Thread(
+                target=submit_and_resolve, args=(make_example(n), tid, t),
+            )
+            w.start()
+            waiters.append(w)
+            continue
         try:
             fut = engine.submit(
                 make_example(n), deadline_s=deadline_s, trace_id=tid
@@ -298,6 +359,7 @@ def _report(mode, offered, dt, tally: _Tally, engine, **extra) -> dict:
         "offered": offered,
         "served": tally.served,
         "rejected_queue_full": tally.rejected_queue_full,
+        "queue_full_retries": tally.queue_full_retries,
         "deadline_misses": tally.deadline_misses,
         "errors": tally.errors,
         "duration_s": dt,

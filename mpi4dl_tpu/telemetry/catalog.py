@@ -43,7 +43,8 @@ CATALOG: "dict[str, MetricSpec]" = {
     "serve_requests_total": MetricSpec(
         "counter", ("outcome",),
         "Terminal request outcomes: served, served_late, "
-        "rejected_queue_full, rejected_deadline.",
+        "rejected_queue_full, rejected_deadline, drained (flushed by a "
+        "deliberate stop/drain — excluded from the availability SLO).",
     ),
     "serve_queue_depth": MetricSpec(
         "gauge", (),
@@ -165,6 +166,46 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Advisory replica count a fleet controller should run, from "
         "windowed queue depth + rejection rate + page burn with "
         "hysteresis and cooldown (telemetry/autoscale.py).",
+    ),
+    # -- fleet (mpi4dl_tpu/fleet/: router.py, supervisor.py) -----------------
+    "fleet_requests_total": MetricSpec(
+        "counter", ("outcome",),
+        "Router-terminal request outcomes: served, failed (retry budget "
+        "spent), rejected_queue_full (router admission), "
+        "rejected_deadline, drained (router stopped).",
+    ),
+    "fleet_requeues_total": MetricSpec(
+        "counter", ("reason",),
+        "Requests moved back to the router queue for a survivor, by "
+        "reason: dispatch_error, replica_queue_full, replica_removed "
+        "(supervisor-confirmed death).",
+    ),
+    "fleet_dispatches_total": MetricSpec(
+        "counter", ("replica", "outcome"),
+        "Per-attempt replica RPCs, by outcome: ok, error, queue_full, "
+        "deadline.",
+    ),
+    "fleet_inflight": MetricSpec(
+        "gauge", ("replica",),
+        "Requests currently in a replica's in-flight ledger (dispatched, "
+        "not yet resolved) — what gets requeued if the replica dies.",
+    ),
+    "fleet_replicas": MetricSpec(
+        "gauge", ("state",),
+        "Fleet membership by state: configured and healthy (router "
+        "view), desired, running, starting, backoff, draining, "
+        "circuit_open (supervisor view).",
+    ),
+    "fleet_replica_restarts_total": MetricSpec(
+        "counter", ("replica", "reason"),
+        "Supervisor-initiated replica replacements, by reason: exit, "
+        "heartbeat (stale beats), unhealthy (/healthz 503 streak).",
+    ),
+    "fleet_recovery_seconds": MetricSpec(
+        "gauge", (),
+        "Most recent death-to-replacement-serving duration: from a "
+        "replica's confirmed death to its successor joining the router "
+        "(trend-tracked by the fleet_2replica bench extra).",
     ),
     # -- federation (mpi4dl_tpu/telemetry/federation.py) ---------------------
     "federation_replicas": MetricSpec(

@@ -70,6 +70,11 @@ class Objective:
     metric: str
     good_outcomes: tuple = ()
     outcome_label: str = "outcome"
+    # Outcomes excluded from the availability denominator entirely:
+    # neither good nor bad. "drained" (a deliberate stop/drain flushing
+    # the queue) is the canonical member — a fleet scale-down is a
+    # lifecycle event and must not burn the availability budget.
+    ignore_outcomes: tuple = ()
     threshold_s: float = 0.0
 
     def __post_init__(self):
@@ -90,11 +95,12 @@ def availability_objective(
     target: float,
     metric: str = "serve_requests_total",
     good: "tuple | list" = ("served",),
+    ignore: "tuple | list" = ("drained",),
     name: str = "availability",
 ) -> Objective:
     return Objective(
         name=name, kind="availability", target=target, metric=metric,
-        good_outcomes=tuple(good),
+        good_outcomes=tuple(good), ignore_outcomes=tuple(ignore),
     )
 
 
@@ -137,6 +143,7 @@ def sli(window, objective: Objective, window_s: float) -> "float | None":
         return window.availability(
             objective.metric, window_s, objective.good_outcomes,
             label=objective.outcome_label,
+            ignore=objective.ignore_outcomes,
         )
     # latency
     h = window.hist_increase(objective.metric, window_s)
@@ -170,11 +177,16 @@ def cumulative_sli(registry, objective: Objective) -> "float | None":
     if not series:
         return None
     if objective.kind == "availability":
-        total = sum(s["value"] for s in series)
+        ignored = set(objective.ignore_outcomes)
+        counted = [
+            s for s in series
+            if s["labels"].get(objective.outcome_label) not in ignored
+        ]
+        total = sum(s["value"] for s in counted)
         if total <= 0:
             return None
         good = sum(
-            s["value"] for s in series
+            s["value"] for s in counted
             if s["labels"].get(objective.outcome_label)
             in objective.good_outcomes
         )

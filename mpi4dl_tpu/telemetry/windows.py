@@ -247,15 +247,22 @@ class SnapshotWindow:
 
     def availability(
         self, name: str, window_s: float, good: "tuple | list",
-        label: str = "outcome",
+        label: str = "outcome", ignore: "tuple | list" = (),
     ) -> "float | None":
         """Good-event ratio of a labeled counter over the window: sum of
         the ``good`` label values' increases / sum of ALL series'
-        increases. None when the window saw no events (no data is
+        increases, except ``ignore`` label values, which leave the
+        denominator too (drained requests are neither success nor
+        failure). None when the window saw no events (no data is
         neither 100% nor 0%)."""
         incs = self.increases(name, window_s)
         if not incs:
             return None
+        ignore_set = set(ignore)
+        incs = [
+            (labels_, d) for labels_, d in incs
+            if labels_.get(label) not in ignore_set
+        ]
         total = sum(d for _, d in incs)
         if total <= 0:
             return None

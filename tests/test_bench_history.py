@@ -77,6 +77,36 @@ def test_extract_series_memory_keys():
     assert s["serving_amoebanet3_32px.peak_hbm_bytes[b32]"] == 2.7e6
 
 
+def test_fleet_recovery_series_trended_and_inverted(tmp_path):
+    """ISSUE CI satellite: the fleet_2replica extra's recovery latency
+    becomes a trend series with the regression sign inverted — a SLOWER
+    death-to-replacement is the regression."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    r = _result(7.0, 0.5)
+    r["extras"]["fleet_2replica"] = {
+        "value": 350.0, "requeued": 4, "recovery_s": 7.1,
+    }
+    s = extract_series(r)
+    assert s["fleet_2replica"] == 350.0            # rps: higher is better
+    assert s["fleet_2replica.recovery_s"] == 7.1   # latency: lower is
+    assert lower_is_better("fleet_2replica.recovery_s")
+    assert not lower_is_better("fleet_2replica")
+    fast, slow = _result(7.0, 0.5), _result(7.0, 0.5)
+    fast["extras"]["fleet_2replica"] = {"value": 350.0, "recovery_s": 7.0}
+    slow["extras"]["fleet_2replica"] = {"value": 350.0, "recovery_s": 9.0}
+    paths = _write_rounds(tmp_path, [_round(1, 0, fast),
+                                     _round(2, 0, slow)])
+    assert main(paths) == 1  # +29% recovery latency: CI-visible
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [fast, slow]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["fleet_2replica.recovery_s"]["verdict"] == "regressed"
+
+
 def test_peak_hbm_series_regresses_on_growth(tmp_path):
     """ISSUE satellite: memory series get the SAME verdict treatment as
     throughput — tolerance band, compare against the last round that

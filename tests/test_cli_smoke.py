@@ -154,6 +154,26 @@ def test_analyze_trace_export_cli(tmp_path, capsys):
     assert main(["trace-export", str(log), "--trace-id", "missing"]) == 1
 
 
+def test_fleet_cli_plan_smoke(capsys):
+    """ISSUE CI satellite: `python -m mpi4dl_tpu.fleet --plan` — the
+    pure-dispatch path: chaos specs parsed + validated, the fleet plan
+    printed as JSON, no process spawned, no model compiled. Bad specs
+    and out-of-fleet targets are usage errors, not silent no-ops."""
+    from mpi4dl_tpu.fleet.__main__ import main
+
+    rc = main(["--replicas", "2", "--chaos", "kill:1@2",
+               "--chaos", "delay-scrape:0=3", "--plan"])
+    assert rc == 0
+    plan = json.loads(capsys.readouterr().out)
+    assert plan["replicas"] == 2
+    assert plan["chaos"] == ["kill:r1@+2s", "delay-scrape:r0=3s@+1s"]
+    assert "mpi4dl_tpu.fleet.worker" in " ".join(plan["worker_cmd"])
+    assert plan["federation"] is True
+    # Unknown action and a target outside the fleet: loud exit 2.
+    assert main(["--replicas", "2", "--chaos", "explode:1", "--plan"]) == 2
+    assert main(["--replicas", "2", "--chaos", "kill:5", "--plan"]) == 2
+
+
 def test_analyze_memory_plan_cli(tmp_path, capsys):
     """ISSUE CI satellite: `python -m mpi4dl_tpu.analyze memory-plan`
     artifact mode end-to-end through the CLI's real dispatch — committed

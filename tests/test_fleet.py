@@ -1,0 +1,619 @@
+"""Fault-tolerant replica fleet (ISSUE 8 tentpole): chaos-spec parsing,
+router requeue/exactly-once semantics against fake replicas, supervisor
+backoff + circuit breaker with trivial no-JAX workers, and the tier-1
+chaos drill: router + 2 real replica subprocesses under closed-loop
+load, ``kill -9`` one mid-flight, zero accepted-request loss, no double
+execution, supervisor replacement, and one requeued request's client →
+router → dead-replica → survivor trace join.
+"""
+
+import json
+import os
+import signal
+import sys
+import textwrap
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mpi4dl_tpu import elastic, telemetry
+from mpi4dl_tpu.fleet import (
+    ChaosOp,
+    FleetRequestError,
+    Router,
+    parse_chaos_spec,
+)
+from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
+from mpi4dl_tpu.serve.engine import DrainedError, QueueFullError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- chaos spec parsing -------------------------------------------------------
+
+
+def test_chaos_spec_parsing_goldens():
+    assert parse_chaos_spec("kill:1") == ChaosOp("kill", target=1)
+    assert parse_chaos_spec("wedge:0@2.5") == ChaosOp(
+        "wedge", target=0, at_s=2.5
+    )
+    assert parse_chaos_spec("blackhole@3s") == ChaosOp("blackhole", at_s=3.0)
+    op = parse_chaos_spec("delay-scrape:1=3@2")
+    assert (op.action, op.target, op.seconds, op.at_s) == (
+        "delay-scrape", 1, 3.0, 2.0
+    )
+    assert op.describe() == "delay-scrape:r1=3s@+2s"
+
+
+def test_chaos_spec_errors():
+    for bad in ("explode:1", "kill:x", "", "kill:1@@2"):
+        with pytest.raises(ValueError):
+            parse_chaos_spec(bad)
+
+
+# -- fake replicas: the router's unit-test doubles ----------------------------
+
+
+class _FakeReplica:
+    """A predict/healthz endpoint with scriptable behavior — the router
+    sees a real HTTP surface without paying an engine compile."""
+
+    def __init__(self, mode="ok"):
+        self.mode = mode
+        self.served_trace_ids: "list[str]" = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"healthy": True, "queue_depth": 0})
+                else:
+                    self._reply(404, {})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length).decode())
+                if fake.mode == "queue_full_once":
+                    fake.mode = "ok"
+                    self._reply(429, {
+                        "ok": False, "error": "queue_full",
+                        "retry_after_s": 0.01,
+                    })
+                    return
+                if fake.mode == "error":
+                    self._reply(500, {"ok": False, "error": "boom"})
+                    return
+                fake.served_trace_ids.append(req["trace_id"])
+                x = np.zeros(4, np.float32)
+                import base64
+
+                self._reply(200, {
+                    "ok": True,
+                    "logits_b64": base64.b64encode(x.tobytes()).decode(),
+                    "dtype": "float32", "shape": [4],
+                    "trace_id": req["trace_id"],
+                    "engine_e2e_s": 0.001, "pid": os.getpid(),
+                })
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _mk_router(**kw):
+    kw.setdefault("example_shape", (2, 2, 3))
+    kw.setdefault("default_deadline_s", 10.0)
+    kw.setdefault("inflight_per_replica", 2)
+    kw.setdefault("health_interval_s", 0.05)
+    return Router(**kw)
+
+
+def test_router_serves_and_balances_across_fakes():
+    fakes = [_FakeReplica(), _FakeReplica()]
+    router = _mk_router()
+    try:
+        for i, f in enumerate(fakes):
+            router.add_replica(f"r{i}", f.url, health_url=f.url)
+        futs = [
+            router.submit(np.zeros((2, 2, 3), np.float32))
+            for _ in range(16)
+        ]
+        for fut in futs:
+            out = fut.result(timeout=10)
+            assert out.shape == (4,)
+            assert fut.trace_id  # propagation surface on the future
+            assert fut.e2e_latency_s == pytest.approx(0.001)
+        s = router.stats()
+        assert s["served"] == 16 and s["failed"] == 0
+        # Both replicas took work (2 in-flight slots each; 16 requests).
+        assert len(fakes[0].served_trace_ids) > 0
+        assert len(fakes[1].served_trace_ids) > 0
+        assert router.registry.get("fleet_requests_total").value(
+            outcome="served"
+        ) == 16
+    finally:
+        router.stop(drain=False)
+        for f in fakes:
+            f.close()
+
+
+def test_router_requeues_dead_replica_onto_survivor():
+    """One replica is a dead port (connection refused), one serves: every
+    future must still resolve with a result, the dead attempts count as
+    dispatch errors + requeues, and the dead replica is marked down."""
+    dead = _FakeReplica()
+    dead_url = dead.url
+    dead.close()  # guaranteed-refused port
+    alive = _FakeReplica()
+    router = _mk_router(max_attempts=4)
+    try:
+        router.add_replica("dead", dead_url, health_url=dead_url)
+        router.add_replica("alive", alive.url, health_url=alive.url)
+        futs = [
+            router.submit(np.zeros((2, 2, 3), np.float32))
+            for _ in range(12)
+        ]
+        for fut in futs:
+            assert fut.result(timeout=15).shape == (4,)
+        s = router.stats()
+        assert s["served"] == 12 and s["failed"] == 0
+        reps = {r["name"]: r for r in s["replicas"]}
+        assert reps["dead"]["healthy"] is False
+        err = router.registry.get("fleet_dispatches_total").value(
+            replica="dead", outcome="error"
+        )
+        if err:  # the health scrape may win the race and mark it down
+            # before any dispatch — but if a dispatch failed, it MUST
+            # have been requeued, never lost.
+            assert router.registry.get("fleet_requeues_total").value(
+                reason="dispatch_error"
+            ) >= 1
+    finally:
+        router.stop(drain=False)
+        alive.close()
+
+
+def test_router_failed_after_max_attempts_is_typed():
+    """Every replica erroring: the future must fail with the TYPED
+    FleetRequestError naming attempts/replicas — never hang, never a
+    bare socket error."""
+    bad = _FakeReplica(mode="error")
+    router = _mk_router(max_attempts=2)
+    try:
+        router.add_replica("bad", bad.url, health_url=bad.url)
+        fut = router.submit(np.zeros((2, 2, 3), np.float32))
+        with pytest.raises(FleetRequestError) as ei:
+            fut.result(timeout=15)
+        assert ei.value.attempts == 2
+        assert "bad" in ei.value.replicas
+        assert router.stats()["failed"] == 1
+    finally:
+        router.stop(drain=False)
+        bad.close()
+
+
+def test_router_replica_queue_full_requeues_without_burning_attempts():
+    """A queue-full bounce is back-pressure, not failure: the request
+    retries (on the same fleet) and serves; the bounce lands in
+    fleet_requeues_total{reason=replica_queue_full}."""
+    fake = _FakeReplica(mode="queue_full_once")
+    router = _mk_router(max_attempts=1)
+    try:
+        router.add_replica("r0", fake.url, health_url=fake.url)
+        fut = router.submit(np.zeros((2, 2, 3), np.float32))
+        assert fut.result(timeout=15).shape == (4,)
+        assert router.registry.get("fleet_requeues_total").value(
+            reason="replica_queue_full"
+        ) == 1
+        assert router.stats()["failed"] == 0
+    finally:
+        router.stop(drain=False)
+        fake.close()
+
+
+def test_router_admission_and_drain():
+    """No replicas: admission still bounds the queue (QueueFullError with
+    a retry hint), and stop(drain=False) fails the backlog with the
+    typed DrainedError + the drained outcome (not availability burn)."""
+    router = _mk_router(max_queue=2)
+    futs = [router.submit(np.zeros((2, 2, 3), np.float32))
+            for _ in range(2)]
+    with pytest.raises(QueueFullError) as ei:
+        router.submit(np.zeros((2, 2, 3), np.float32))
+    assert ei.value.retry_after_s is not None
+    router.stop(drain=False)
+    for fut in futs:
+        with pytest.raises(DrainedError):
+            fut.result(timeout=5)
+    assert router.registry.get("fleet_requests_total").value(
+        outcome="drained"
+    ) == 2
+    assert router.registry.get("fleet_requests_total").value(
+        outcome="rejected_queue_full"
+    ) == 1
+
+
+def test_router_remove_replica_requeue_is_exactly_once():
+    """remove_replica requeues the in-flight ledger; a later stale
+    requeue for the same dispatch epoch is a no-op (the guard that
+    prevents a dead replica's late-failing RPC thread from re-enqueueing
+    a request a survivor already owns)."""
+    router = _mk_router()
+    try:
+        rec_cls = type(router)._Record if hasattr(type(router), "_Record") \
+            else None
+        from mpi4dl_tpu.fleet.router import _Record
+
+        rec = _Record(
+            x=np.zeros((2, 2, 3), np.float32), submit_t=time.monotonic(),
+            deadline=time.monotonic() + 30, future=__import__(
+                "concurrent.futures", fromlist=["Future"]
+            ).Future(), trace_id="t-1",
+        )
+        rec.state, rec.epoch = "inflight", 1
+        assert router._requeue(rec, 1, reason="replica_removed",
+                               count_attempt=False) is True
+        assert rec.state == "pending"
+        # Stale epoch (or already-pending state): no-op, no double count.
+        assert router._requeue(rec, 1, reason="replica_removed",
+                               count_attempt=False) is False
+        assert router.stats()["requeued"] == 1
+        del rec_cls
+    finally:
+        router.stop(drain=False)
+
+
+# -- supervisor: breaker + restart accounting with no-JAX workers -------------
+
+
+def _stub_worker(tmp_path, body: str) -> "list[str]":
+    """A worker stand-in honoring the --ready-file contract."""
+    path = tmp_path / "stub_worker.py"
+    path.write_text(textwrap.dedent(body))
+    return [sys.executable, str(path)]
+
+
+def _mk_supervisor(tmp_path, cmd, **kw):
+    sup = FleetSupervisor(
+        [], registry=telemetry.MetricsRegistry(),
+        base_dir=str(tmp_path / "fleet"),
+        reconcile_interval_s=0.05,
+        heartbeat_timeout_s=None,
+        unhealthy_after=10_000,  # stubs serve no /healthz
+        backoff_base_s=0.01, backoff_max_s=0.05,
+        spawn_timeout_s=30.0,
+        **kw,
+    )
+    sup._worker_cmd = cmd  # the stub replaces `python -m ...worker`
+    return sup
+
+
+def test_supervisor_replaces_dead_replica_and_counts_restart(tmp_path):
+    cmd = _stub_worker(tmp_path, """
+        import json, os, sys, time
+        ready = sys.argv[sys.argv.index("--ready-file") + 1]
+        tmp = ready + ".tmp"
+        json.dump({"pid": os.getpid(), "predict_port": 1,
+                   "metrics_port": 1}, open(tmp, "w"))
+        os.replace(tmp, ready)
+        time.sleep(3600)
+    """)
+    events = telemetry.JsonlWriter(str(tmp_path / "events"))
+    sup = _mk_supervisor(tmp_path, cmd, replicas=1, events=events)
+    try:
+        sup.start()
+        sup.wait_ready(timeout_s=30)
+        slot = sup.slot_by_index(0)
+        pid = slot.pid
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sup.running_count() == 1 and slot.pid != pid:
+                break
+            time.sleep(0.05)
+        assert slot.pid != pid and slot.state == "running"
+        assert sup.restarts == 1
+        assert sup.registry.get("fleet_replica_restarts_total").value(
+            replica="r0", reason="exit"
+        ) == 1
+        assert sup.last_recovery_s is not None
+        assert sup.registry.get("fleet_recovery_seconds").value() \
+            == sup.last_recovery_s
+        # The restart landed as the schema-valid elastic.restart event.
+        events.close()
+        evs = telemetry.read_events(events.path)
+        restarts = [e for e in evs if e.get("name") == "elastic.restart"]
+        assert restarts and restarts[0]["attrs"]["replica"] == "r0"
+    finally:
+        sup.close()
+
+
+def test_supervisor_circuit_breaker_trips_and_pages(tmp_path):
+    """A crash-looping replica: after K failures in the window the slot
+    goes circuit_open — no more respawns — and the page rides the stock
+    alert machinery (alert_active gauge + alert.transition event)."""
+    cmd = _stub_worker(tmp_path, "raise SystemExit(3)")
+    events = telemetry.JsonlWriter(str(tmp_path / "events"))
+    sup = _mk_supervisor(
+        tmp_path, cmd, replicas=1, events=events,
+        breaker_max_restarts=2, breaker_window_s=60.0,
+    )
+    try:
+        sup.start()
+        deadline = time.monotonic() + 30
+        slot = None
+        while time.monotonic() < deadline:
+            slot = sup.slot_by_index(0)
+            if slot is not None and slot.state == "circuit_open":
+                break
+            time.sleep(0.05)
+        assert slot is not None and slot.state == "circuit_open"
+        assert slot.breaker.tripped
+        assert sup.restarts == 3  # 2 allowed restarts + the tripping one
+        assert sup.registry.get("alert_active").value(
+            alert="fleet_circuit_r0", severity="page"
+        ) == 1.0
+        # No further spawns while open.
+        n = sup.restarts
+        time.sleep(0.3)
+        assert sup.restarts == n
+        events.close()
+        evs = telemetry.read_events(events.path)
+        trans = [e for e in evs if e.get("name") == "alert.transition"]
+        assert any(
+            t["attrs"]["alert"] == "fleet_circuit_r0"
+            and t["attrs"]["to"] == "firing" for t in trans
+        )
+        # Operator override closes the circuit and respawning resumes.
+        sup.reset_breaker("r0")
+        assert sup.slot_by_index(0).state in ("backoff", "starting")
+        assert sup.registry.get("alert_active").value(
+            alert="fleet_circuit_r0", severity="page"
+        ) == 0.0
+    finally:
+        sup.close()
+
+
+# -- elastic satellites -------------------------------------------------------
+
+
+def test_full_jitter_backoff_deterministic():
+    rng = lambda: 1.0  # noqa: E731 — upper envelope
+    assert elastic.full_jitter_backoff(1, 0.5, 30.0, rng) == 0.5
+    assert elastic.full_jitter_backoff(2, 0.5, 30.0, rng) == 1.0
+    assert elastic.full_jitter_backoff(8, 0.5, 30.0, rng) == 30.0  # capped
+    assert elastic.full_jitter_backoff(3, 0.5, 30.0, lambda: 0.5) == 1.0
+    assert elastic.full_jitter_backoff(0, 0.5, 30.0, rng) == 0.0
+    assert elastic.full_jitter_backoff(3, 0.0, 30.0, rng) == 0.0
+
+
+def test_restart_breaker_windowed():
+    t = [0.0]
+    br = elastic.RestartBreaker(2, window_s=10.0, clock=lambda: t[0])
+    for _ in range(2):
+        br.record_failure()
+        assert br.allow()
+    br.record_failure()
+    assert not br.allow() and br.tripped  # 3 failures inside the window
+    br.reset()
+    # Same 3 failures spread past the window: old ones age out.
+    for dt in (0.0, 11.0, 22.0):
+        t[0] = dt
+        br.record_failure()
+        assert br.allow(), dt
+    assert br.state()["failures_in_window"] == 1
+
+
+def test_supervise_backoff_and_restart_event(tmp_path):
+    """ISSUE satellite: supervise() restarts with exponential full-jitter
+    backoff and emits a schema-valid elastic.restart event per restart."""
+    marker = tmp_path / "ok.txt"
+    w = tmp_path / "worker.py"
+    w.write_text(textwrap.dedent(f"""
+        import sys
+        if "--resume" not in sys.argv:
+            sys.exit(3)
+        open({str(marker)!r}, "w").write("ok")
+    """))
+    events = telemetry.JsonlWriter(str(tmp_path / "ev"))
+    sleeps = []
+    msgs = []
+    rc = elastic.supervise(
+        [str(w)], max_restarts=2, poll_interval=0.05,
+        backoff_base_s=0.5, rng=lambda: 1.0, _sleep=sleeps.append,
+        events=events, _print=msgs.append,
+    )
+    assert rc == 0 and marker.exists()
+    assert sleeps == [0.5]  # attempt 1, full-jitter upper envelope
+    assert any("after 0.50s backoff" in m for m in msgs)
+    events.close()
+    evs = telemetry.read_events(events.path)  # read_events validates
+    restarts = [e for e in evs if e["name"] == "elastic.restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["attrs"]["attempt"] == 1
+    assert restarts[0]["attrs"]["backoff_s"] == 0.5
+    assert restarts[0]["attrs"]["reason"] == "rc=3"
+
+
+def test_supervise_windowed_breaker_gives_up(tmp_path):
+    w = tmp_path / "crash.py"
+    w.write_text("raise SystemExit(7)")
+    msgs = []
+    rc = elastic.supervise(
+        [str(w)], max_restarts=2, restart_window_s=300.0,
+        resume_arg=None, poll_interval=0.05, backoff_base_s=0.0,
+        _print=msgs.append,
+    )
+    assert rc == 7
+    assert any("within 300s" in m for m in msgs)
+
+
+# -- the tier-1 chaos drill ---------------------------------------------------
+
+
+def _drill_events(tele_dir) -> "list[dict]":
+    events = []
+    for f in sorted(os.listdir(tele_dir)):
+        if f.endswith(".jsonl"):
+            events.extend(
+                telemetry.read_events(os.path.join(tele_dir, str(f)))
+            )
+    return events
+
+
+def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
+    """ISSUE acceptance: 2 replicas under closed-loop load, kill -9 one
+    mid-flight. Zero accepted-request loss (every future resolves with a
+    result), no request served twice, the survivor absorbs the requeue,
+    the supervisor restores the fleet to the (federated)
+    autoscale_desired_replicas count, and one requeued request's trace
+    joins client → router → dead replica → survivor."""
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.telemetry.autoscale import AutoscaleConfig
+
+    tele = str(tmp_path / "tele")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    router = Router(
+        example_shape=(16, 16, 3), max_attempts=4,
+        inflight_per_replica=4, health_interval_s=0.1,
+        telemetry_dir=tele,
+    )
+    sup = FleetSupervisor(
+        ["--image-size", "16", "--max-batch", "2",
+         "--telemetry-dir", tele],
+        router=router,
+        replicas=2, max_replicas=2,
+        federation=telemetry.SLOConfig(
+            availability=0.999, interval_s=0.5,
+            autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+        ),
+        env=env,
+        base_dir=str(tmp_path / "fleet"),
+        reconcile_interval_s=0.1,
+        heartbeat_timeout_s=5.0,
+        backoff_base_s=0.1, backoff_max_s=0.5,
+        spawn_timeout_s=420.0,
+    )
+    n_requests = 400
+    try:
+        sup.start()
+        sup.wait_ready(timeout_s=420)
+
+        report = {}
+
+        def load():
+            report.update(run_closed_loop(
+                router, n_requests, concurrency=8, deadline_s=120.0,
+                events=router.events,
+            ))
+
+        t = threading.Thread(target=load)
+        t.start()
+        # Deterministic mid-flight kill: wait for real traffic, then
+        # SIGKILL replica 1 while requests are queued and in flight.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if router.stats()["served"] >= 40:
+                break
+            time.sleep(0.01)
+        victim = sup.slot_by_index(1)
+        victim_pid = victim.pid
+        os.kill(victim_pid, signal.SIGKILL)
+        t.join(timeout=300)
+        assert not t.is_alive(), "load run wedged"
+
+        # Zero accepted-request loss: every submitted future resolved,
+        # with a RESULT (the survivor absorbed the requeue).
+        assert report["served"] == n_requests, report
+        assert report["errors"] == 0 and report["deadline_misses"] == 0
+        stats = router.stats()
+        assert stats["requeued"] >= 1  # the ledger moved to the survivor
+        assert router.registry.get("fleet_requeues_total").value(
+            reason="replica_removed"
+        ) or router.registry.get("fleet_requeues_total").value(
+            reason="dispatch_error"
+        )
+
+        # Supervisor restores the fleet to the federated desired count.
+        assert sup.desired_replicas() == 2
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            if sup.running_count() == 2:
+                break
+            time.sleep(0.2)
+        assert sup.running_count() == 2, sup.state()
+        assert sup.restarts >= 1
+        assert sup.slot_by_index(1).pid != victim_pid
+        assert sup.last_recovery_s is not None
+        assert sup.registry.get("fleet_replica_restarts_total").value(
+            replica="r1", reason="exit"
+        ) >= 1
+    finally:
+        sup.close()
+        router.stop(drain=False)
+
+    # Postmortem over the flushed logs (workers SIGTERMed + router
+    # stopped above, so every writer closed/flushed).
+    events = _drill_events(tele)
+    # No double execution: across every replica's engine log, no trace
+    # id was SERVED twice.
+    served_by_tid: "dict[str, int]" = {}
+    for e in events:
+        if (
+            e.get("kind") == "span" and e.get("name") == "serve.request"
+            and e["attrs"].get("outcome") == "served"
+        ):
+            served_by_tid[e["trace_id"]] = (
+                served_by_tid.get(e["trace_id"], 0) + 1
+            )
+    doubles = {t: n for t, n in served_by_tid.items() if n > 1}
+    assert not doubles, f"double-served trace ids: {doubles}"
+
+    # One requeued request's full lifetime joins under a single id:
+    # client segment, the router's dead-replica attempt, the survivor's
+    # engine spans.
+    groups = telemetry.group_spans_by_trace(events)
+    joined = None
+    for tid, evs in groups.items():
+        disp = [e for e in evs if e["name"] == "router.dispatch"]
+        replicas = {e["attrs"]["replica"] for e in disp}
+        if len(replicas) > 1 and any(
+            e["attrs"]["outcome"] != "ok" for e in disp
+        ):
+            names = {e["name"] for e in evs}
+            if {"client.request", "router.request",
+                    "serve.request"} <= names:
+                joined = tid
+                break
+    assert joined is not None, "no requeued trace joined all three hops"
+    doc = telemetry.chrome_trace(events, trace_id=joined)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    span_names = {e["name"] for e in xs}
+    assert any(n.startswith("rpc_") for n in span_names)  # both hops
+    assert {"queue_wait", "device_compute"} <= span_names  # survivor
+    assert len({e["pid"] for e in xs}) >= 2  # client+router pid, engine pid

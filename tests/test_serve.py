@@ -16,6 +16,7 @@ cross-bucket parity (bucket-1 vs bucket-4 executables) is checked to 1e-5.
 
 import json
 import os
+import threading
 import time
 
 import jax
@@ -222,6 +223,80 @@ def test_admission_control_bounded_queue(model):
     s = eng.stats()
     assert s["rejected_queue_full"] == 1
     assert s["served"] == 2
+
+
+def test_queue_full_carries_retry_after_hint(model):
+    """ISSUE satellite: a queue-full rejection tells the client WHEN to
+    come back — the live batch cadence (floored at the formation
+    window), seeded from the warm latency before the first batch."""
+    eng = _engine(model, max_queue=1)
+    eng.submit(_examples(1)[0])
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(_examples(1)[0])
+    hint = ei.value.retry_after_s
+    assert hint is not None and hint > 0
+    # Pre-first-batch: the warm latency (or the wait window) stands in.
+    assert hint >= max(eng._max_wait_s, 0.0)
+    eng.start()
+    eng.stop()
+    # Post-serving: the hint follows the measured completion cadence.
+    assert eng.retry_after_hint() > 0
+
+
+def test_stop_without_drain_counts_drained_outcome(model):
+    """ISSUE satellite: flushed-on-stop requests resolve with the typed
+    DrainedError and the distinct outcome="drained" counter label —
+    and the availability SLO math EXCLUDES them (a router-initiated
+    drain must not burn the availability budget)."""
+    from mpi4dl_tpu.serve.engine import DrainedError
+    from mpi4dl_tpu.telemetry.slo import (
+        availability_objective,
+        cumulative_sli,
+    )
+
+    eng = _engine(model, max_queue=8)
+    futs = [eng.submit(x) for x in _examples(3)]
+    eng.stop(drain=False)  # engine never started: pure flush
+    for f in futs:
+        with pytest.raises(DrainedError):
+            f.result(timeout=5)
+    s = eng.stats()
+    assert s["drained"] == 3 and s["served"] == 0
+    assert eng.registry.get("serve_requests_total").value(
+        outcome="drained"
+    ) == 3
+    # Drained-only traffic: no availability data at all (not 0%).
+    obj = availability_objective(0.999)
+    assert cumulative_sli(eng.registry, obj) is None
+    # Mixed traffic: drained leaves the denominator entirely.
+    eng.registry.get("serve_requests_total").inc(7, outcome="served")
+    assert cumulative_sli(eng.registry, obj) == 1.0
+
+
+def test_loadgen_retries_queue_full_with_backoff(model):
+    """ISSUE satellite: opt-in bounded retry on admission bounces — the
+    run measures shed-and-retry behavior (retries counted, requests
+    eventually served) instead of instant failures."""
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    eng = _engine(model, max_queue=2, max_wait_s=0.001)
+    # Deterministic bounces: the engine starts 50ms into the load, so
+    # the 2-slot queue fills instantly and every further submit bounces
+    # into the retry loop until the batcher comes up.
+    starter = threading.Timer(0.05, eng.start)
+    starter.start()
+    try:
+        rep = run_closed_loop(
+            eng, 24, concurrency=8, deadline_s=30.0,
+            queue_full_retries=200, retry_backoff_s=0.002,
+        )
+    finally:
+        starter.join()
+        eng.stop()
+    # Every bounce was absorbed by a retry; nothing was lost.
+    assert rep["served"] + rep["rejected_queue_full"] == 24
+    assert rep["served"] == 24
+    assert rep["queue_full_retries"] >= 1  # the queue DID bounce
 
 
 def test_submit_after_stop_raises(model):

@@ -70,6 +70,28 @@ def test_window_rate_and_increase_golden():
     assert w2.rate("serve_requests_total", 30, outcome="served") is None
 
 
+def test_window_availability_ignores_drained_outcomes():
+    """ISSUE satellite: drained requests (deliberate stop/drain) leave
+    the availability denominator entirely — a fleet scale-down neither
+    helps nor hurts the SLI."""
+    reg, c = _reg_with_counter()
+    clock = _Clock()
+    w = SnapshotWindow(reg, clock=clock)
+    w.record(0.0)
+    c.inc(9, outcome="served")
+    c.inc(1, outcome="rejected_queue_full")
+    c.inc(40, outcome="drained")
+    clock.t = 30.0
+    w.record(30.0)
+    # Without the ignore set the 40 drains would crater the SLI to 0.18.
+    assert w.availability(
+        "serve_requests_total", 30, ("served",)
+    ) == pytest.approx(9 / 50)
+    assert w.availability(
+        "serve_requests_total", 30, ("served",), ignore=("drained",)
+    ) == pytest.approx(0.9)
+
+
 def test_window_uses_at_least_the_requested_span():
     """With snapshots at 0/10/20/30 a 15s window must pair the newest
     with t=10 (latest at-or-before the cutoff), not t=20 — windows cover

@@ -580,6 +580,13 @@ def full_stack(tmp_path_factory):
     )
     agg.scrape_once()
     assert agg.registry.get("federation_replicas").value(state="up") == 1
+    # Fleet publisher (mpi4dl_tpu/fleet): the router/supervisor declare
+    # the fleet_* names at construction; the one-call declare keeps the
+    # catalog==runtime pin honest without spawning a fleet here (the
+    # live series are exercised by tests/test_fleet.py).
+    from mpi4dl_tpu import fleet
+
+    fleet.declare_metrics(reg)
     engine.stop()
     engine.lint_report()  # hlolint_* gauges
 
