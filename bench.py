@@ -20,7 +20,10 @@ count — see mpi4dl_tpu/flops.py); the north star is ≥45% (BASELINE.json).
 Train entries carry p50/p90/p99 step-time tails (``step_time_s``), and a
 ``serving_*`` extra measures the online serving engine (mpi4dl_tpu/serve):
 dynamic micro-batching throughput vs the batch-size-1 serial baseline with
-request-latency percentiles (``BENCH_SERVING=0`` disables).
+request-latency percentiles (``BENCH_SERVING=0`` disables). The
+``sp2x2_overlap`` extra runs the spatial-parallel train step's
+monolithic-vs-decomposed conv A/B on a CPU-mesh subprocess and embeds both
+arms' measured ``trace_overlap_ratio`` (``BENCH_SP_OVERLAP=0`` disables).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -525,6 +528,46 @@ def _measure_fleet() -> dict:
         router.stop(drain=False)
 
 
+def _measure_sp_overlap() -> dict:
+    """SP 2×2 halo/compute-overlap A/B extra: run the spatially-
+    partitioned train step with the monolithic AND the decomposed conv
+    impl (``MPI4DL_TPU_CONV_OVERLAP``) and embed both arms' measured
+    ``trace_overlap_ratio`` + step time in the result line — the number
+    ``analyze bench-history`` trends (a falling ratio regresses). Runs as
+    a subprocess on a 4-virtual-device CPU mesh: this bench process owns
+    the accelerator (one chip — no 2×2 tile mesh exists on it), and the
+    property under measurement is the compiled program's schedule freedom,
+    which the CPU thunk executor exhibits the same way."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    # Each arm pins its own impl; an inherited process-wide override
+    # would silently collapse the A/B into one arm measured twice.
+    env.pop("MPI4DL_TPU_CONV_OVERLAP", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analyze", "sp-overlap",
+         "--size", "64", "--steps", "4", "--trials", "3", "--json", "-"],
+        env=env, capture_output=True, text=True, timeout=900, cwd=repo,
+    )
+    line = next(
+        (ln for ln in reversed(proc.stdout.splitlines())
+         if ln.startswith("{")), None,
+    )
+    if line is None:
+        raise RuntimeError(
+            f"sp-overlap emitted no JSON (rc={proc.returncode}): "
+            f"{proc.stderr[-300:]}"
+        )
+    out = json.loads(line)
+    out["rc"] = proc.returncode
+    return out
+
+
 def _serving_attribution(trace_dir, lint_report) -> "dict | None":
     """Measured device-time attribution of the serving load run
     (analysis/trace.py over the engine's own ``mpi4dl_serve_batch``
@@ -637,10 +680,16 @@ def _trace_attribution() -> "dict | None":
             program="train_step",
         )
         _LAST_RUN["state"] = state
+        from mpi4dl_tpu.ops.layers import conv_overlap_impl
+
         out = {
             "n_steps": summary["n_steps"],
             "per_step_mean": summary["per_step_mean"],
             "overlap": summary["collective"],
+            # Which spatial-conv impl produced this attribution: the
+            # monolithic/decomposed A/B (sp2x2_overlap extra) must be
+            # attributable from the result line alone.
+            "conv_impl": conv_overlap_impl(),
         }
         lint_rep = _LAST_RUN.get("lint_report")
         if lint_rep is not None:
@@ -934,6 +983,12 @@ def main():
     # -9): rps-through-the-fault, requeue count, recovery latency.
     if os.environ.get("BENCH_FLEET", "1") != "0":
         run_extra("fleet_2replica", _measure_fleet, est_seconds=120.0)
+
+    # SP 2x2 halo/compute overlap A/B (CPU-mesh subprocess): both conv
+    # impls' measured trace_overlap_ratio + step time in one round, so
+    # bench-history can trend the overlap trajectory per arm.
+    if os.environ.get("BENCH_SP_OVERLAP", "1") != "0":
+        run_extra("sp2x2_overlap", _measure_sp_overlap, est_seconds=240.0)
 
     if which in ("resnet", "all") and not on_cpu:
         def peak_px():

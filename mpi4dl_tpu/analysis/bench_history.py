@@ -83,6 +83,14 @@ def extract_series(result: dict) -> "dict[str, float]":
         hlo.get("peak_hbm_bytes"), (int, float)
     ):
         out["hlo.peak_hbm_bytes"] = float(hlo["peak_hbm_bytes"])
+    # Headline measured overlap: the fraction of collective time hidden
+    # behind compute in the train-step capture. Falling = regression
+    # (the inverse sign of the latency/memory series below).
+    attr = result.get("attribution")
+    if isinstance(attr, dict):
+        ratio = (attr.get("overlap") or {}).get("overlap_ratio")
+        if isinstance(ratio, (int, float)):
+            out["attribution.trace_overlap_ratio"] = float(ratio)
     for name, entry in (result.get("extras") or {}).items():
         if not isinstance(entry, dict):
             continue
@@ -100,15 +108,33 @@ def extract_series(result: dict) -> "dict[str, float]":
         # slower recovery (a grown number) reads as the regression.
         if isinstance(entry.get("recovery_s"), (int, float)):
             out[f"{name}.recovery_s"] = float(entry["recovery_s"])
+        # Overlap A/B extra (sp2x2_overlap): per-arm measured overlap
+        # ratio (falling fails) and SP train-step time (growing fails).
+        arms = entry.get("arms")
+        if isinstance(arms, dict):
+            for arm, rec in arms.items():
+                if not isinstance(rec, dict):
+                    continue
+                ratio = rec.get("trace_overlap_ratio")
+                if isinstance(ratio, (int, float)):
+                    out[f"{name}.trace_overlap_ratio[{arm}]"] = float(ratio)
+                st = rec.get("step_time_s")
+                if isinstance(st, (int, float)):
+                    out[f"{name}.step_time_s[{arm}]"] = float(st)
     return out
 
 
 def lower_is_better(key: str) -> bool:
-    """Memory and recovery-latency series regress UPWARD: a grown
-    footprint or a slower death-to-replacement is the failure, a shrunk
-    one the improvement — the inverse of every throughput/capability
-    series."""
-    return "peak_hbm_bytes" in key or key.endswith(".recovery_s")
+    """Memory, latency, and step-time series regress UPWARD: a grown
+    footprint, a slower death-to-replacement, or a slower SP train step
+    is the failure, a shrunk one the improvement — the inverse of every
+    throughput/capability/overlap-ratio series (``trace_overlap_ratio``
+    keeps the normal direction: FALLING overlap fails CI)."""
+    return (
+        "peak_hbm_bytes" in key
+        or key.endswith(".recovery_s")
+        or ".step_time_s" in key
+    )
 
 
 def compare(rounds: "list[dict]", tolerance: float, strict: bool) -> dict:

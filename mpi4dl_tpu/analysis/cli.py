@@ -14,7 +14,7 @@ Examples::
     python -m mpi4dl_tpu.analyze --model amoebanet --size 64 --dp 2
     python -m mpi4dl_tpu.analyze --model resnet --size 512 --write-baseline
 
-Three subcommands: ``python -m mpi4dl_tpu.analyze bench-history
+Subcommands: ``python -m mpi4dl_tpu.analyze bench-history
 BENCH_r*.json`` compares the committed bench rounds and fails on a
 throughput regression (:mod:`mpi4dl_tpu.analysis.bench_history`);
 ``python -m mpi4dl_tpu.analyze trace-export LOG... [--trace-id ID]``
@@ -25,7 +25,12 @@ device lifetime across process boundaries
 ``python -m mpi4dl_tpu.analyze memory-plan`` predicts peak HBM vs the
 device limit for a requested config — compile-only, nothing executes —
 and bisects the max feasible px/bucket
-(:mod:`mpi4dl_tpu.analysis.memory_plan`).
+(:mod:`mpi4dl_tpu.analysis.memory_plan`);
+``python -m mpi4dl_tpu.analyze sp-overlap`` measures the SP 2×2 train
+step's halo/compute overlap A/B — monolithic vs decomposed spatial conv
+— with live trace attribution, partition-math lint, and the
+``trace-overlap-crosscheck`` on each arm
+(:mod:`mpi4dl_tpu.analysis.overlap_bench`).
 """
 
 from __future__ import annotations
@@ -157,6 +162,13 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.telemetry.federation import trace_export_main
 
         return trace_export_main(argv[1:])
+    if argv and argv[0] == "sp-overlap":
+        # SP 2x2 halo/compute overlap A/B (monolithic vs decomposed
+        # spatial conv): sets up its own CPU mesh + jax like the lint
+        # path, measures a live capture per arm, lints both programs.
+        from mpi4dl_tpu.analysis.overlap_bench import main as sp_overlap
+
+        return sp_overlap(argv[1:])
     if argv and argv[0] == "memory-plan":
         # Feasibility planner. Its artifact mode (committed peaks vs a
         # limit) is pure JSON and must dispatch before any backend

@@ -377,7 +377,10 @@ class FleetSupervisor:
     def _step_alert(self, slot: _Slot, now: float) -> None:
         """The circuit-open page rides the stock AlertState machinery:
         alert_active gauge + alert.transition events — one /alertz-shaped
-        runbook for burn alerts, memory pages, and fleet pages alike."""
+        runbook for burn alerts, memory pages, and fleet pages alike.
+        A FIRING transition auto-files the evidence the runbook used to
+        collect by hand: the dead incarnation's worker-log tail and the
+        latest ``oom.report`` from the fleet's telemetry event log."""
         moved = slot.alert.step(slot.state == "circuit_open", now)
         self._m_alert.set(
             1.0 if slot.alert.state == "firing" else 0.0,
@@ -399,10 +402,81 @@ class FleetSupervisor:
                 "breaker": slot.breaker.state(),
             },
         }
+        if moved[1] == "firing":
+            ev["attrs"]["evidence"] = self._breaker_evidence(slot)
         if self._flight is not None:
             self._flight.record(ev)
         if self._events is not None and getattr(self._events, "enabled", False):
             self._events.write(ev)
+
+    # -- breaker-page evidence -------------------------------------------------
+
+    def _breaker_evidence(self, slot: _Slot, max_bytes: int = 2048) -> dict:
+        """Evidence bundle for a circuit-open page: the worker log tail
+        (this slot's incarnations append to one file) and the latest
+        ``oom.report`` event in the fleet's JSONL telemetry log (the
+        worker env's ``MPI4DL_TPU_TELEMETRY_DIR``), if either exists.
+        Best-effort by construction — the page must fire even when the
+        evidence is unreadable."""
+        out: dict = {}
+        log_path = (
+            getattr(slot.proc, "log_path", None)
+            if slot.proc is not None else None
+        )
+        if log_path:
+            try:
+                with open(log_path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - max_bytes))
+                    out["log_tail"] = f.read().decode("utf-8", "replace")
+                    out["log_path"] = log_path
+            except OSError:
+                pass
+        oom = self._latest_oom_report()
+        if oom is not None:
+            out["oom_report"] = oom
+        return out
+
+    def _latest_oom_report(self, tail_bytes: int = 262144) -> "dict | None":
+        """Newest ``oom.report`` event across the fleet telemetry dir's
+        JSONL files (newest file first, last matching line wins; only the
+        final ``tail_bytes`` of each file are scanned — evidence, not an
+        audit)."""
+        import glob
+        import json as _json
+
+        from mpi4dl_tpu.telemetry import jsonl as _jsonl
+
+        tdir = self._env.get(_jsonl.ENV_DIR)
+        if not tdir or not os.path.isdir(tdir):
+            return None
+        paths = sorted(
+            glob.glob(os.path.join(tdir, "*.jsonl")),
+            key=lambda p: os.path.getmtime(p), reverse=True,
+        )
+        for path in paths:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - tail_bytes))
+                    chunk = f.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+            lines = chunk.splitlines()
+            if size > tail_bytes and lines:
+                lines = lines[1:]  # drop the possibly-truncated first line
+            for line in reversed(lines):
+                if '"oom.report"' not in line:
+                    continue
+                try:
+                    ev = _json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("name") == "oom.report":
+                    return ev
+        return None
 
     def reset_breaker(self, name: str) -> None:
         """Operator override: close a slot's circuit and let the next

@@ -53,6 +53,103 @@ def _pair(v) -> tuple[int, int]:
     return (int(v), int(v))
 
 
+def conv_overlap_impl() -> str:
+    """Spatial windowed-op decomposition selector: ``"monolithic"``
+    (default — one VALID op over the whole halo-extended tile) or
+    ``"decomposed"`` (interior op with NO data dependency on the halo
+    ppermutes + thin boundary-strip ops consuming the exchanged halo,
+    stitched into the identical output — see :func:`overlap_decompose`).
+    ``MPI4DL_TPU_CONV_OVERLAP`` sets the process default; the ``overlap=``
+    field on :class:`Conv2d` / :class:`Pool` overrides per layer."""
+    import os
+
+    impl = os.environ.get("MPI4DL_TPU_CONV_OVERLAP", "monolithic")
+    impl = {
+        "0": "monolithic", "off": "monolithic",
+        "1": "decomposed", "on": "decomposed",
+    }.get(impl, impl)
+    if impl not in ("monolithic", "decomposed"):
+        raise ValueError(
+            "MPI4DL_TPU_CONV_OVERLAP must be monolithic|decomposed "
+            f"(or 0/1/off/on), got {impl!r}"
+        )
+    return impl
+
+
+def _strip_bounds(n: int, k: int, s: int, p: int) -> tuple[int, int, int]:
+    """Per-dim split of a spatial op's output rows into halo-dependent
+    boundary strips and a halo-free interior.
+
+    A VALID windowed op over the halo-extended tile produces ``n // s``
+    output rows (post-trim); output row ``i`` consumes input rows
+    ``[i*s - p, i*s - p + k - 1]`` of the LOCAL tile. Rows whose window
+    stays inside ``[0, n)`` need no neighbor data. Returns
+    ``(t_lo, t_hi, n_out)``: the count of output rows needing the
+    low-side / high-side halo, and the trimmed output extent."""
+    n_out = n // s
+    t_lo = min(n_out, -(-p // s))  # first interior row: ceil(p/s)
+    hi_int = (n - k + p) // s      # last row with i*s + k-1 - p <= n-1
+    t_hi = min(n_out, max(0, n_out - 1 - hi_int))
+    return t_lo, t_hi, n_out
+
+
+def overlap_decompose(x, xe, op, kh, kw, sh, sw, ph, pw):
+    """Compute ``op(xe)[:, :H//sh, :W//sw]`` as an interior application on
+    the un-exchanged tile plus thin boundary-strip applications on the
+    halo-extended tile — exact output stitching, different dataflow.
+
+    ``op`` is any position-independent VALID windowed op with strides
+    ``(sh, sw)`` and window ``(kh, kw)`` (a conv, a pool). The interior
+    call reads ``x`` alone, so it has NO data dependency on the
+    ``lax.ppermute`` chain that produced ``xe`` and XLA's scheduler is
+    free to run it concurrently with the exchange (the T3/FLUX
+    interior/boundary overlap decomposition, arXiv:2401.16677 /
+    2406.06858). The boundary strips — at most ``ceil(p/s)`` output
+    rows/cols per side — consume the halo once it arrives. Every output
+    window sees exactly the bytes the monolithic op saw (boundary fill
+    included, since the strips slice ``xe`` itself), so the stitched
+    result is window-for-window identical.
+
+    Returns the stitched ``[B, H//sh, W//sw, C']`` array, or ``None``
+    when the tile is too small to have a non-empty interior in both dims
+    (caller falls back to the monolithic path)."""
+    b, h, w, c = x.shape
+    tt, tb, ho = _strip_bounds(h, kh, sh, ph)
+    tl, tr, wo = _strip_bounds(w, kw, sw, pw)
+    if tt + tb >= ho or tl + tr >= wo or (tt + tb + tl + tr) == 0:
+        return None
+    n_ih, n_iw = ho - tt - tb, wo - tl - tr
+    r0, c0 = tt * sh - ph, tl * sw - pw
+    y_int = op(x[
+        :,
+        r0 : r0 + (n_ih - 1) * sh + kh,
+        c0 : c0 + (n_iw - 1) * sw + kw,
+        :,
+    ])
+    # Middle band: [left strip | interior | right strip] over the interior
+    # rows; the side strips read xe rows aligned with the interior ones.
+    mid = y_int
+    if tl:
+        y_l = op(xe[
+            :, tt * sh : (ho - tb - 1) * sh + kh, : (tl - 1) * sw + kw, :
+        ])
+        mid = jnp.concatenate([y_l[:, :n_ih, :tl, :], mid], axis=2)
+    if tr:
+        y_r = op(xe[
+            :, tt * sh : (ho - tb - 1) * sh + kh, (wo - tr) * sw :, :
+        ])
+        mid = jnp.concatenate([mid, y_r[:, :n_ih, :tr, :]], axis=2)
+    parts = []
+    if tt:
+        y_top = op(xe[:, : (tt - 1) * sh + kh, :, :])
+        parts.append(y_top[:, :tt, :wo, :])
+    parts.append(mid)
+    if tb:
+        y_bot = op(xe[:, (ho - tb) * sh :, :, :])
+        parts.append(y_bot[:, :tb, :wo, :])
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
 def _check_window_coverage(kh, kw, sh, sw, ph, pw):
     """A spatially-partitioned windowed op is only exact when the halo
     (== padding) covers the window overlap beyond the stride: windows that
@@ -258,6 +355,15 @@ class Conv2d(nn.Module):
     ``exchange=False`` (with ``spatial=True``) gives the D2 "shrink" conv: no
     exchange, VALID conv on an input that already carries a wide halo — the
     output halo shrinks by (k-1)/2 (ref ``resnet_spatial_d2.py``).
+
+    ``overlap``: ``"monolithic"`` | ``"decomposed"`` | None (None reads
+    ``MPI4DL_TPU_CONV_OVERLAP``). The decomposed impl splits the exchange
+    form into an interior conv with no halo dependency plus boundary-strip
+    convs (:func:`overlap_decompose`) so XLA can hide the
+    collective-permutes behind the interior MXU work; outputs are
+    window-for-window identical and the permute inventory is unchanged
+    (``halo_exchange`` is still called exactly once). NHWC only — the
+    packed layout keeps the monolithic exchange.
     """
 
     features: int
@@ -268,6 +374,7 @@ class Conv2d(nn.Module):
     spatial: bool = False
     exchange: bool = True
     pack: tuple[int, int] = (1, 1)  # (pack_in, pack_out); (1,1) = NHWC
+    overlap: "str | None" = None  # None → MPI4DL_TPU_CONV_OVERLAP
     dtype: Any = None
 
     @nn.compact
@@ -322,11 +429,27 @@ class Conv2d(nn.Module):
         if self.exchange:
             _check_window_coverage(kh, kw, sh, sw, ph, pw)
             h_loc, w_loc = x.shape[1], x.shape[2]
-            x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+            xe = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W)
+            impl = self.overlap if self.overlap is not None else (
+                conv_overlap_impl()
+            )
+            if impl not in ("monolithic", "decomposed"):
+                raise ValueError(
+                    f"overlap must be monolithic|decomposed, got {impl!r}"
+                )
+            if impl == "decomposed" and (ph or pw):
+                # Interior conv reads the UN-exchanged tile: no data
+                # dependency on the halo ppermutes, so the scheduler can
+                # overlap them; boundary strips consume xe. Flax binds all
+                # calls to the one "conv" submodule, so the param tree is
+                # identical to the monolithic form.
+                y = overlap_decompose(x, xe, conv, kh, kw, sh, sw, ph, pw)
+                if y is not None:
+                    return y
             # Trim to this tile's share of the global output grid. The first
             # VALID output aligns with the global grid because tile sizes are
             # multiples of the stride (power-of-two asserts, config.validate).
-            return conv(x)[:, : h_loc // sh, : w_loc // sw, :]
+            return conv(xe)[:, : h_loc // sh, : w_loc // sw, :]
 
         # D2 shrink conv: input already carries a wide halo; VALID conv eats
         # (k-1) of it per dim. Strided shrink convs are handled by the D2
@@ -486,6 +609,7 @@ class Pool(nn.Module):
     padding: Any = 0
     spatial: bool = False
     count_include_pad: bool = True  # torch AvgPool2d default; AmoebaNet uses False
+    overlap: "str | None" = None  # None → MPI4DL_TPU_CONV_OVERLAP
 
     @nn.compact
     def __call__(self, x):
@@ -501,6 +625,9 @@ class Pool(nn.Module):
         if self.spatial and (ph or pw):
             fill = float("-inf") if self.kind == "max" else 0.0
             if self.kind == "avg" and not self.count_include_pad:
+                # Monolithic only: the mask-ratio form below couples the
+                # numerator and divisor pools to one exchanged layout; the
+                # overlap decomposition covers the fill-value forms.
                 # Exact distributed count_include_pad=False: average = ratio
                 # of two sum-pools. The divisor pool runs on a validity mask
                 # built LOCALLY from tile position (ones, zeroed on the
@@ -519,66 +646,91 @@ class Pool(nn.Module):
                 )
                 y = num / den
                 return y[:, : h_loc // sh, : w_loc // sw, :]
-            x = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W, fill_value=fill)
+            exchanged = True
             pad = ((0, 0), (0, 0))
         else:
+            exchanged = False
             pad = ((ph, ph), (pw, pw))
 
-        if self.kind == "max":
-            from mpi4dl_tpu.ops import pool_pallas
+        def apply_pool(t, pad):
+            if self.kind == "max":
+                from mpi4dl_tpu.ops import pool_pallas
 
-            if (
-                (sh, sw) != (1, 1)
-                and pool_bwd_impl() != "decomposed"  # explicit A/B lever wins
-                and pool_pallas.dispatchable(
-                    x, kh, kw, sh, sw, pad[0][0], pad[1][0]
-                )
-            ):
-                # Strided pools (the REDUCTION cells' k3 s2 / k2 s2):
-                # identical forward to reduce_window; the backward is the
-                # one-pass Pallas kernel instead of select_and_scatter
-                # (6.9% of the AmoebaNet@1024 step — docs/PERF.md round 4).
-                y = pool_pallas.max_pool(x, kh, kw, sh, sw, pad[0][0], pad[1][0])
-            elif (sh, sw) == (1, 1):
-                # Stride-1: shifted-maximum decomposition (cheap backward;
-                # see max_pool_s1_valid). -inf edge pad == torch MaxPool2d.
-                # Strided pools deliberately stay on reduce_window: slicing
-                # the s1 maxima by the stride is forward-identical but
-                # measured a 22% END-TO-END REGRESSION on AmoebaNet@1024
-                # (6.37 -> 4.94 img/s) — the full-resolution maximum tree +
-                # its full-res backward select chain costs far more than the
-                # select_and_scatter it removes (docs/PERF.md round 3).
-                if pad != ((0, 0), (0, 0)):
-                    x = lax.pad(
-                        x,
-                        jnp.asarray(float("-inf"), x.dtype),
-                        ((0, 0, 0), (*pad[0], 0), (*pad[1], 0), (0, 0, 0)),
+                if (
+                    (sh, sw) != (1, 1)
+                    and pool_bwd_impl() != "decomposed"  # explicit A/B lever
+                    and pool_pallas.dispatchable(
+                        t, kh, kw, sh, sw, pad[0][0], pad[1][0]
                     )
-                y = max_pool_s1_valid(x, kh, kw)
-            elif pool_bwd_impl() == "decomposed":
-                # A/B lever only (default "xla" — see pool_bwd_impl for
-                # the measured negative result): reduce_window forward +
-                # the first-match mask backward, bit-matching the XLA path
-                # in both directions.
-                y = max_pool_strided(
-                    x, kh, kw, sh, sw, pad[0][0], pad[1][0]
+                ):
+                    # Strided pools (the REDUCTION cells' k3 s2 / k2 s2):
+                    # identical forward to reduce_window; the backward is
+                    # the one-pass Pallas kernel instead of
+                    # select_and_scatter (6.9% of the AmoebaNet@1024 step —
+                    # docs/PERF.md round 4).
+                    return pool_pallas.max_pool(
+                        t, kh, kw, sh, sw, pad[0][0], pad[1][0]
+                    )
+                if (sh, sw) == (1, 1):
+                    # Stride-1: shifted-maximum decomposition (cheap
+                    # backward; see max_pool_s1_valid). -inf edge pad ==
+                    # torch MaxPool2d. Strided pools deliberately stay on
+                    # reduce_window: slicing the s1 maxima by the stride is
+                    # forward-identical but measured a 22% END-TO-END
+                    # REGRESSION on AmoebaNet@1024 (6.37 -> 4.94 img/s) —
+                    # the full-resolution maximum tree + its full-res
+                    # backward select chain costs far more than the
+                    # select_and_scatter it removes (docs/PERF.md round 3).
+                    if pad != ((0, 0), (0, 0)):
+                        t = lax.pad(
+                            t,
+                            jnp.asarray(float("-inf"), t.dtype),
+                            ((0, 0, 0), (*pad[0], 0), (*pad[1], 0), (0, 0, 0)),
+                        )
+                    return max_pool_s1_valid(t, kh, kw)
+                if pool_bwd_impl() == "decomposed":
+                    # A/B lever only (default "xla" — see pool_bwd_impl for
+                    # the measured negative result): reduce_window forward +
+                    # the first-match mask backward, bit-matching the XLA
+                    # path in both directions.
+                    return max_pool_strided(
+                        t, kh, kw, sh, sw, pad[0][0], pad[1][0]
+                    )
+                return nn.max_pool(t, (kh, kw), strides=(sh, sw), padding=pad)
+            if self.kind == "avg":
+                return nn.avg_pool(
+                    t,
+                    (kh, kw),
+                    strides=(sh, sw),
+                    padding=pad,
+                    count_include_pad=self.count_include_pad,
                 )
-            else:
-                y = nn.max_pool(x, (kh, kw), strides=(sh, sw), padding=pad)
-        elif self.kind == "avg":
-            y = nn.avg_pool(
-                x,
-                (kh, kw),
-                strides=(sh, sw),
-                padding=pad,
-                count_include_pad=self.count_include_pad,
-            )
-        else:
             raise ValueError(f"unknown pool kind {self.kind!r}")
 
-        if self.spatial and (ph or pw):
-            y = y[:, : h_loc // sh, : w_loc // sw, :]
-        return y
+        if not exchanged:
+            return apply_pool(x, pad)
+
+        xe = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W, fill_value=fill)
+        impl = self.overlap if self.overlap is not None else (
+            conv_overlap_impl()
+        )
+        if impl not in ("monolithic", "decomposed"):
+            raise ValueError(
+                f"overlap must be monolithic|decomposed, got {impl!r}"
+            )
+        if impl == "decomposed":
+            # Same interior/boundary split as the spatial conv: the
+            # interior pool needs no neighbor data (windows that touch the
+            # halo — fill included — live in the boundary strips, which
+            # slice xe and so see the exact monolithic bytes).
+            y = overlap_decompose(
+                x, xe, lambda t: apply_pool(t, ((0, 0), (0, 0))),
+                kh, kw, sh, sw, ph, pw,
+            )
+            if y is not None:
+                return y
+        y = apply_pool(xe, ((0, 0), (0, 0)))
+        return y[:, : h_loc // sh, : w_loc // sw, :]
 
 
 class HaloExchange(nn.Module):

@@ -54,6 +54,18 @@ def _xla_only_active() -> bool:
     return _XLA_ONLY_DEPTH[0] > 0
 
 
+# Explicit-impl downgrade warns ONCE per process: the hazard (a caller who
+# typed impl="pallas" silently running XLA) needs one loud line, not one
+# per traced layer — a 54-cell model would emit hundreds of identical
+# warnings per trace. Env-selected pallas downgrades silently by design.
+_PALLAS_DOWNGRADE_WARNED = [False]
+
+
+def _reset_pallas_downgrade_warning() -> None:
+    """Test hook: re-arm the once-per-process downgrade warning."""
+    _PALLAS_DOWNGRADE_WARNED[0] = False
+
+
 def _is_batch_tracer(x) -> bool:
     try:  # private module — absence must degrade to "don't know", not crash
         from jax._src.interpreters import batching
@@ -162,9 +174,10 @@ def halo_exchange(
             return halo_exchange_pallas(
                 x, halo_h, halo_w, axis_h, axis_w, fill_value
             )
-        if explicit:
+        if explicit and not _PALLAS_DOWNGRADE_WARNED[0]:
             import warnings
 
+            _PALLAS_DOWNGRADE_WARNED[0] = True
             warnings.warn(
                 "halo_exchange(impl='pallas') downgraded to the XLA path: "
                 "the Pallas remote-DMA kernel deadlocks under batched "

@@ -234,7 +234,8 @@ CATALOG: "dict[str, MetricSpec]" = {
         "gauge", ("program",),
         "Measured fraction of collective time overlapped by concurrent "
         "compute in the latest capture (1.0 = fully hidden; absent when "
-        "the capture saw no collectives).",
+        "the capture saw no collectives). The sp-overlap A/B publishes "
+        "it per arm (program=sp2x2_monolithic / sp2x2_decomposed).",
     ),
     # -- load generator (mpi4dl_tpu/serve/loadgen.py) ------------------------
     "loadgen_requests_total": MetricSpec(

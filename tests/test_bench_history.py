@@ -254,3 +254,69 @@ def test_runs_on_the_committed_round_files(capsys):
     assert "regression(s)" in out
     # Round labels come from the files' own "n" fields.
     assert "r01" in out or "#0" in out
+
+def test_overlap_series_trended_with_correct_signs(tmp_path):
+    """ISSUE satellite: the sp2x2_overlap extra's per-arm measured
+    overlap ratio and SP step time become trend series — a FALLING
+    overlap ratio fails CI (normal higher-is-better direction), while
+    the step time carries the inverted sign (growing fails), mirroring
+    recovery_s/peak_hbm_bytes. The headline attribution's ratio is
+    trended too."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def with_overlap(mono_ratio, dec_ratio, dec_step):
+        r = _result(7.0, 0.5)
+        r["attribution"] = {
+            "overlap": {"overlap_ratio": 0.61, "verdict": "overlapped"},
+            "conv_impl": "monolithic",
+        }
+        r["extras"]["sp2x2_overlap"] = {"arms": {
+            "monolithic": {"trace_overlap_ratio": mono_ratio,
+                           "step_time_s": 0.9},
+            "decomposed": {"trace_overlap_ratio": dec_ratio,
+                           "step_time_s": dec_step},
+        }}
+        return r
+
+    s = extract_series(with_overlap(0.60, 0.64, 1.4))
+    assert s["attribution.trace_overlap_ratio"] == 0.61
+    assert s["sp2x2_overlap.trace_overlap_ratio[monolithic]"] == 0.60
+    assert s["sp2x2_overlap.trace_overlap_ratio[decomposed]"] == 0.64
+    assert s["sp2x2_overlap.step_time_s[decomposed]"] == 1.4
+    assert not lower_is_better("sp2x2_overlap.trace_overlap_ratio[decomposed]")
+    assert not lower_is_better("attribution.trace_overlap_ratio")
+    assert lower_is_better("sp2x2_overlap.step_time_s[decomposed]")
+
+    # A falling decomposed overlap ratio is a CI-visible regression.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_overlap(0.60, 0.64, 1.4)),
+        _round(2, 0, with_overlap(0.60, 0.50, 1.4)),   # ratio fell 22%
+    ])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0,
+          "result": r}
+         for i, (p, r) in enumerate(zip(paths, [
+             with_overlap(0.60, 0.64, 1.4), with_overlap(0.60, 0.50, 1.4),
+         ]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key[
+        "sp2x2_overlap.trace_overlap_ratio[decomposed]"
+    ]["verdict"] == "regressed"
+
+    # A grown SP step time regresses; a grown ratio improves.
+    cmp = compare(
+        [{"path": "a", "n": 1, "rc": 0,
+          "result": with_overlap(0.60, 0.64, 1.4)},
+         {"path": "b", "n": 2, "rc": 0,
+          "result": with_overlap(0.60, 0.70, 1.8)}],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["sp2x2_overlap.step_time_s[decomposed]"][
+        "verdict"] == "regressed"
+    assert by_key["sp2x2_overlap.trace_overlap_ratio[decomposed]"][
+        "verdict"] == "improved"
+    assert cmp["ok"] is False

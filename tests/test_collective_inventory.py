@@ -156,3 +156,38 @@ def test_sp_plus_lp_pipeline_inventory():
         "all-to-all": 0,
         "reduce-scatter": 2,
     }, inv
+
+
+def test_spatial_trainer_decomposed_overlap_keeps_permute_window(monkeypatch):
+    """ISSUE 9 acceptance: under MPI4DL_TPU_CONV_OVERLAP=decomposed the
+    SAME SP 2×2 program decomposes each spatial conv into interior +
+    boundary strips, but halo_exchange still runs exactly once per conv —
+    so the counted forward shifts are unchanged (20) and the compiled
+    permute inventory must stay inside the partition-math window
+    [shifts, 2*shifts]; the full rule set (halo-window included) must be
+    clean on the decomposed program."""
+    monkeypatch.setenv("MPI4DL_TPU_CONV_OVERLAP", "decomposed")
+    cfg = ParallelConfig(
+        batch_size=4, split_size=1, spatial_size=1, num_spatial_parts=(4,),
+        slice_method="square", image_size=32, data_parallel=1,
+    )
+    plain = get_resnet_v1(depth=8)
+    cells = get_resnet_v1(depth=8, spatial_cells=3)
+    tr = Trainer(cells, num_spatial_cells=3, config=cfg, plain_cells=plain)
+    state = tr.init(jax.random.PRNGKey(0), (4, 32, 32, 3))
+    xs, ys = tr.shard_batch(*_batch(4, 32))
+
+    shifts = tr.halo_shift_count(state.params, (4, 32, 32, 3))
+    assert shifts == 20, shifts  # identical to the monolithic derivation
+
+    compiled = tr._jit_step.lower(state, xs, ys).compile()
+    inv = collective_inventory(compiled.as_text(), ops=OPS)
+    assert shifts <= inv["collective-permute"] <= 2 * shifts, inv
+    assert inv["all-to-all"] == 0
+    assert inv["all-gather"] == 2  # tile join pair, unchanged
+
+    report = analyze_compiled(
+        compiled,
+        expected=Expectations(tile_shape=cfg.tile_shape, halo_shifts=shifts),
+    )
+    _no_errors(report)

@@ -395,6 +395,95 @@ def test_supervisor_circuit_breaker_trips_and_pages(tmp_path):
         sup.close()
 
 
+def test_breaker_page_auto_files_log_tail_and_oom_report(tmp_path):
+    """ISSUE satellite: the firing circuit-open transition carries an
+    auto-filed evidence bundle — the dead worker's log tail and the
+    latest oom.report from the fleet telemetry dir — the two pulls the
+    runbook previously collected by hand."""
+    cmd = _stub_worker(tmp_path, """
+        import sys
+        print("boom: synthetic compile failure in stub worker",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
+    """)
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    oom_ev = {
+        "ts": 1.0, "kind": "event", "name": "oom.report",
+        "attrs": {"program": "serve_predict", "bucket": 32,
+                  "parsed": {"used": 123, "limit": 456}},
+    }
+    with open(tdir / "telemetry-w.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 0.5, "kind": "event",
+                            "name": "engine.start", "attrs": {}}) + "\n")
+        f.write(json.dumps(oom_ev) + "\n")
+    events = telemetry.JsonlWriter(str(tmp_path / "events"))
+    env = dict(os.environ, MPI4DL_TPU_TELEMETRY_DIR=str(tdir))
+    sup = _mk_supervisor(
+        tmp_path, cmd, replicas=1, events=events, env=env,
+        breaker_max_restarts=2, breaker_window_s=60.0,
+    )
+    try:
+        sup.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            slot = sup.slot_by_index(0)
+            if slot is not None and slot.state == "circuit_open":
+                break
+            time.sleep(0.05)
+        assert sup.slot_by_index(0).state == "circuit_open"
+        events.close()
+        evs = telemetry.read_events(events.path)
+        firing = [
+            e for e in evs
+            if e.get("name") == "alert.transition"
+            and e["attrs"].get("to") == "firing"
+        ]
+        assert firing, [e.get("name") for e in evs]
+        evidence = firing[0]["attrs"]["evidence"]
+        assert "boom: synthetic compile failure" in evidence["log_tail"]
+        assert evidence["log_path"].endswith("r0.log")
+        assert evidence["oom_report"]["attrs"]["program"] == "serve_predict"
+        # Non-firing transitions (the reset below) carry no bundle.
+        sup.reset_breaker("r0")
+    finally:
+        sup.close()
+
+
+def test_breaker_evidence_degrades_without_log_or_telemetry(tmp_path):
+    """No telemetry dir configured and no oom history: the page still
+    fires, with whatever evidence exists (the log tail)."""
+    cmd = _stub_worker(tmp_path, "raise SystemExit(4)")
+    events = telemetry.JsonlWriter(str(tmp_path / "events"))
+    env = dict(os.environ)
+    env.pop("MPI4DL_TPU_TELEMETRY_DIR", None)
+    sup = _mk_supervisor(
+        tmp_path, cmd, replicas=1, events=events, env=env,
+        breaker_max_restarts=1, breaker_window_s=60.0,
+    )
+    try:
+        sup.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            slot = sup.slot_by_index(0)
+            if slot is not None and slot.state == "circuit_open":
+                break
+            time.sleep(0.05)
+        assert sup.slot_by_index(0).state == "circuit_open"
+        events.close()
+        firing = [
+            e for e in telemetry.read_events(events.path)
+            if e.get("name") == "alert.transition"
+            and e["attrs"].get("to") == "firing"
+        ]
+        assert firing
+        evidence = firing[0]["attrs"]["evidence"]
+        assert "oom_report" not in evidence
+        assert "log_tail" in evidence  # the empty-but-present worker log
+    finally:
+        sup.close()
+
+
 # -- elastic satellites -------------------------------------------------------
 
 

@@ -94,3 +94,64 @@ def test_halo_exchange_zero_halo_is_identity():
     )
     xs = jax.device_put(x, NamedSharding(mesh, spec))
     np.testing.assert_array_equal(np.asarray(jax.jit(fn)(xs)), np.asarray(x))
+
+
+# -- Pallas->XLA downgrade warning (ISSUE satellite) --------------------------
+
+
+def _exchange(x, mesh, **kw):
+    spec = P(None, "tile_h", "tile_w", None)
+    fn = jax.jit(shard_map(
+        lambda t: halo_exchange(t, 1, 1, **kw),
+        mesh=mesh, in_specs=(spec,), out_specs=spec,
+    ))
+    xs = jax.device_put(x, NamedSharding(mesh, spec))
+    return np.asarray(fn(xs))
+
+
+def test_explicit_pallas_under_xla_only_warns_once_and_is_correct():
+    """ISSUE satellite: explicit ``impl="pallas"`` while the XLA-only
+    guard is active downgrades with EXACTLY ONE warning per process — a
+    54-cell model must not emit one warning per traced layer — and the
+    downgraded output equals the XLA path's."""
+    import warnings
+
+    from mpi4dl_tpu.parallel import halo
+
+    mesh = _mesh(2, 2)
+    x = jnp.arange(2 * 8 * 8 * 2, dtype=jnp.float32).reshape(2, 8, 8, 2)
+    halo._reset_pallas_downgrade_warning()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        with halo.xla_halo_only():
+            got1 = _exchange(x, mesh, impl="pallas")
+            # A second fresh trace in the same process: no second warning.
+            got2 = _exchange(x + 1.0, mesh, impl="pallas")
+    downgrades = [w for w in rec if "downgraded" in str(w.message)]
+    assert len(downgrades) == 1, [str(w.message) for w in rec]
+    ref = _exchange(x, mesh, impl="xla")
+    np.testing.assert_array_equal(got1, ref)
+    np.testing.assert_array_equal(
+        got2, _exchange(x + 1.0, mesh, impl="xla")
+    )
+
+
+def test_env_selected_pallas_downgrades_silently(monkeypatch):
+    """ISSUE satellite: MPI4DL_TPU_HALO_IMPL=pallas (no explicit impl=)
+    under the XLA-only guard downgrades with NO warning — the env default
+    is a preference, not a per-callsite promise — and stays correct."""
+    import warnings
+
+    from mpi4dl_tpu.parallel import halo
+
+    monkeypatch.setenv("MPI4DL_TPU_HALO_IMPL", "pallas")
+    mesh = _mesh(2, 2)
+    x = jnp.arange(1 * 8 * 8 * 1, dtype=jnp.float32).reshape(1, 8, 8, 1)
+    halo._reset_pallas_downgrade_warning()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        with halo.xla_halo_only():
+            got = _exchange(x, mesh)
+    assert [w for w in rec if "downgraded" in str(w.message)] == []
+    monkeypatch.delenv("MPI4DL_TPU_HALO_IMPL")
+    np.testing.assert_array_equal(got, _exchange(x, mesh, impl="xla"))
