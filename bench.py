@@ -429,6 +429,18 @@ def _measure_serving() -> dict:
         entry["client_overhead_ms"] = {
             k: round(v * 1e3, 3) for k, v in rep["client_overhead_s"].items()
         }
+    # Tail forensics (docs/OBSERVABILITY.md "Tail forensics"): the
+    # p99/p50 latency ratio — the tail's SHAPE, independent of the
+    # box's absolute speed — trended by bench-history with the
+    # regression sign inverted (a growing tail fails CI), plus how many
+    # tail.samples the watcher captured this round.
+    lat_p = rep.get("latency_s") or {}
+    if lat_p.get("p50") and lat_p.get("p99"):
+        entry["tail"] = {
+            "p99_p50_ratio": round(lat_p["p99"] / lat_p["p50"], 3),
+            "samples": engine.tail.captured,
+            "threshold_ms": round(engine.tail.threshold() * 1e3, 3),
+        }
     shares = engine.registry.get("serve_phase_share")
     if shares is not None:
         entry["phase_shares"] = {

@@ -108,6 +108,14 @@ def extract_series(result: dict) -> "dict[str, float]":
         # slower recovery (a grown number) reads as the regression.
         if isinstance(entry.get("recovery_s"), (int, float)):
             out[f"{name}.recovery_s"] = float(entry["recovery_s"])
+        # Serving extra: tail shape (p99/p50), trended with the
+        # inverted sign — a growing tail is the regression even when
+        # mean throughput holds.
+        tail = entry.get("tail")
+        if isinstance(tail, dict) and isinstance(
+            tail.get("p99_p50_ratio"), (int, float)
+        ):
+            out[f"{name}.tail_p99_p50_ratio"] = float(tail["p99_p50_ratio"])
         # Overlap A/B extra (sp2x2_overlap): per-arm measured overlap
         # ratio (falling fails) and SP train-step time (growing fails).
         arms = entry.get("arms")
@@ -125,15 +133,17 @@ def extract_series(result: dict) -> "dict[str, float]":
 
 
 def lower_is_better(key: str) -> bool:
-    """Memory, latency, and step-time series regress UPWARD: a grown
-    footprint, a slower death-to-replacement, or a slower SP train step
-    is the failure, a shrunk one the improvement — the inverse of every
-    throughput/capability/overlap-ratio series (``trace_overlap_ratio``
-    keeps the normal direction: FALLING overlap fails CI)."""
+    """Memory, latency, step-time, and tail-shape series regress UPWARD:
+    a grown footprint, a slower death-to-replacement, a slower SP train
+    step, or a fatter p99/p50 tail is the failure, a shrunk one the
+    improvement — the inverse of every throughput/capability/
+    overlap-ratio series (``trace_overlap_ratio`` keeps the normal
+    direction: FALLING overlap fails CI)."""
     return (
         "peak_hbm_bytes" in key
         or key.endswith(".recovery_s")
         or ".step_time_s" in key
+        or key.endswith(".tail_p99_p50_ratio")
     )
 
 

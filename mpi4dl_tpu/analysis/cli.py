@@ -22,6 +22,11 @@ joins span segments from N processes' JSONL telemetry logs by trace id
 and writes one Chrome trace — a request's full client → queue → batch →
 device lifetime across process boundaries
 (:func:`mpi4dl_tpu.telemetry.federation.trace_export_main`);
+``python -m mpi4dl_tpu.analyze tail LOGS... [--trace-id ID] [--top N]``
+joins histogram exemplars, span segments, and ``tail.sample`` events to
+answer "why was this request slow" per trace id — phase breakdown vs the
+window p50, dominant phase named, worst-requests table
+(:mod:`mpi4dl_tpu.analysis.tail`);
 ``python -m mpi4dl_tpu.analyze memory-plan`` predicts peak HBM vs the
 device limit for a requested config — compile-only, nothing executes —
 and bisects the max feasible px/bucket
@@ -162,6 +167,13 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.telemetry.federation import trace_export_main
 
         return trace_export_main(argv[1:])
+    if argv and argv[0] == "tail":
+        # Tail forensics: join histogram exemplars, cross-process span
+        # segments, and tail.sample events to explain slow requests per
+        # trace id. Pure JSON — runs on logs from a dead machine.
+        from mpi4dl_tpu.analysis.tail import main as tail_main
+
+        return tail_main(argv[1:])
     if argv and argv[0] == "sp-overlap":
         # SP 2x2 halo/compute overlap A/B (monolithic vs decomposed
         # spatial conv): sets up its own CPU mesh + jax like the lint

@@ -43,8 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SPEC",
                    help="fault injection, repeatable: "
                         "ACTION[:TARGET][=SECONDS][@AT] with actions "
-                        "kill, wedge, blackhole, delay-scrape — e.g. "
-                        "kill:1@1.5 (SIGKILL replica 1, 1.5s into load)")
+                        "kill, wedge, blackhole, delay-scrape, delay — "
+                        "e.g. kill:1@1.5 (SIGKILL replica 1, 1.5s into "
+                        "load) or delay:1=0.3 (straggler: slow replica "
+                        "1's serving path by 0.3s per batch)")
     p.add_argument("--plan", action="store_true",
                    help="print the fleet plan as JSON and exit without "
                         "spawning anything (pure dispatch)")
@@ -256,6 +258,10 @@ def main(argv=None) -> int:
         report["chaos"] = monkey.log
         report["supervisor"] = sup.state()
         report["router"] = router.stats()
+        if sup.aggregator is not None:
+            # Straggler view (a `delay` drill's verdict surface): which
+            # replica drags the fleet tail, per the federated skew score.
+            report["straggler"] = sup.aggregator.straggler_state()
         report["recovered"] = restored
         report["recovery_s"] = sup.last_recovery_s
         if args.chaos and not restored:

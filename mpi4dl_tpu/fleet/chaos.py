@@ -17,7 +17,12 @@ operator can rehearse them against a live fleet:
   answering (probe black-hole: the router's scrape must time out and
   count it down, not wait forever);
 - ``delay-scrape`` — add seconds of latency to ``/snapshotz`` (slow
-  telemetry must degrade the *federation view*, never the serving path).
+  telemetry must degrade the *federation view*, never the serving path);
+- ``delay`` — add seconds of latency to every batch the replica's
+  engine dispatches (the STRAGGLER shape: the replica stays healthy and
+  keeps serving, just slowly — only the federation-side
+  ``fleet_replica_skew`` scoring names it; docs/OBSERVABILITY.md "Tail
+  forensics").
 
 Spec grammar (``--chaos``, repeatable)::
 
@@ -26,11 +31,12 @@ Spec grammar (``--chaos``, repeatable)::
     kill:1          SIGKILL replica index 1 (at the default +1.0s)
     wedge:0@2.5     wedge replica 0's batcher 2.5s into the load run
     delay-scrape:1=3@2   delay r1's /snapshotz by 3s from t=+2s
+    delay:1=0.3@2   slow r1's serving path by 0.3s/batch from t=+2s
 
 ``TARGET`` is the replica *slot index* (default 0); ``AT`` is seconds
-after the load run starts; ``=SECONDS`` (delay-scrape only) is the added
-latency. Parsing is pure stdlib — ``--plan`` dispatch and the CLI smoke
-never touch a backend.
+after the load run starts; ``=SECONDS`` (delay / delay-scrape) is the
+added latency. Parsing is pure stdlib — ``--plan`` dispatch and the CLI
+smoke never touch a backend.
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ import re
 import threading
 import time
 
-ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape")
+ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape", "delay")
 
 _SPEC_RE = re.compile(
     r"^(?P<action>[a-z-]+)"
@@ -69,7 +75,10 @@ class ChaosOp:
             raise ValueError(f"invalid chaos op: {self}")
 
     def describe(self) -> str:
-        extra = f"={self.seconds:g}s" if self.action == "delay-scrape" else ""
+        extra = (
+            f"={self.seconds:g}s"
+            if self.action in ("delay-scrape", "delay") else ""
+        )
         return f"{self.action}:r{self.target}{extra}@+{self.at_s:g}s"
 
 
@@ -116,6 +125,7 @@ def inject(op: ChaosOp, supervisor) -> dict:
         "wedge": {"action": "wedge"},
         "blackhole": {"action": "blackhole_healthz"},
         "delay-scrape": {"action": "delay_scrape", "seconds": op.seconds},
+        "delay": {"action": "delay_predict", "seconds": op.seconds},
     }
     record.update(slot.client.chaos(**actions[op.action]))
     return record

@@ -87,6 +87,7 @@ ROUTER_METRICS = (
     "fleet_dispatches_total",
     "fleet_inflight",
     "fleet_replicas",
+    "fleet_request_latency_seconds",
 )
 
 
@@ -229,6 +230,9 @@ class Router:
             self.registry, "fleet_dispatches_total"
         )
         self._m_inflight = telemetry.declare(self.registry, "fleet_inflight")
+        self._m_latency = telemetry.declare(
+            self.registry, "fleet_request_latency_seconds"
+        )
         self._m_replicas = telemetry.declare(self.registry, "fleet_replicas")
         self._m_replicas.set(0, state="configured")
         self._m_replicas.set(0, state="healthy")
@@ -607,6 +611,11 @@ class Router:
             self._counts["served"] += 1
             self._latencies.append(end - rec.submit_t)
         self._m_requests.inc(outcome="served")
+        # Fleet-level e2e (requeues and retries included) with the trace
+        # id as the bucket exemplar: a scrape of the fleet p99 bucket
+        # names a real request (`analyze tail --trace-id` takes it from
+        # there).
+        self._m_latency.observe(end - rec.submit_t, exemplar=rec.trace_id)
         # The engine's own e2e rides the future (loadgen computes its
         # observed-minus-engine overhead from it — now the router+RPC
         # hop cost instead of the in-process future overhead).

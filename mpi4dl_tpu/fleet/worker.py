@@ -14,7 +14,9 @@ builds a synthetic calibrated model (the same zero-artifact path as
   dispatch mid-loop (submit path and HTTP threads stay alive — the
   wedged-but-alive shape only the watchdog-gated heartbeat exposes),
   ``blackhole_healthz`` makes ``/healthz`` hang, ``delay_scrape`` adds
-  latency to ``/snapshotz``, ``unwedge`` recovers.
+  latency to ``/snapshotz``, ``delay_predict`` adds latency to every
+  dispatched batch (the straggler shape: healthy but slow — only
+  ``fleet_replica_skew`` names it), ``unwedge`` recovers.
 - the standard telemetry surface (``/metrics``, ``/snapshotz``,
   ``/healthz``, ``/debugz``) — built HERE rather than via
   ``metrics_port=`` so the chaos hooks can wrap the health callable and
@@ -72,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="floor of the stall detector — drills shrink it "
                         "so a wedge is declared fast")
     p.add_argument("--telemetry-dir", default=None)
+    p.add_argument("--tail-factor", type=float, default=4.0,
+                   help="slow-request trip multiplier over the rolling "
+                        "p99 (telemetry/tail.py; drills shrink it so a "
+                        "delayed replica's tail.samples capture fast)")
+    p.add_argument("--tail-min-interval", type=float, default=1.0,
+                   help="rate limit between captured tail.samples, "
+                        "seconds")
     return p
 
 
@@ -82,6 +91,7 @@ class _ChaosState:
         self.wedged = threading.Event()
         self.blackhole_healthz = False
         self.scrape_delay_s = 0.0
+        self.predict_delay_s = 0.0
 
     def apply(self, action: str, seconds: float = 0.0) -> dict:
         if action == "wedge":
@@ -92,6 +102,8 @@ class _ChaosState:
             self.blackhole_healthz = True
         elif action == "delay_scrape":
             self.scrape_delay_s = float(seconds)
+        elif action == "delay_predict":
+            self.predict_delay_s = float(seconds)
         else:
             raise ValueError(f"unknown chaos action {action!r}")
         return {"ok": True, "applied": action}
@@ -99,9 +111,14 @@ class _ChaosState:
     def gate_dispatch(self) -> None:
         """Called inside the batcher's dispatch: while wedged, block —
         the loop thread hangs exactly like a stuck device call, while
-        every other thread in the process stays alive."""
+        every other thread in the process stays alive. The straggler
+        drill's delay sleeps here too: every batch pays it, so the
+        replica's OWN latency histogram inflates (which is exactly what
+        federation-side skew scoring reads) while health stays green."""
         while self.wedged.is_set():
             time.sleep(0.05)
+        if self.predict_delay_s > 0:
+            time.sleep(self.predict_delay_s)
 
 
 class _DelayedRegistry:
@@ -264,6 +281,8 @@ def main(argv=None) -> int:
         telemetry_dir=args.telemetry_dir,
         watchdog_factor=args.watchdog_factor or None,
         watchdog_min_timeout_s=args.watchdog_min_timeout,
+        tail_factor=args.tail_factor,
+        tail_min_interval_s=args.tail_min_interval,
     )
 
     chaos = _ChaosState()

@@ -84,6 +84,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flight-dir", default=None,
                    help="where watchdog/crash/SIGTERM flight dumps land "
                         "(default: the telemetry dir, then the temp dir)")
+    p.add_argument("--tail-factor", type=float, default=4.0,
+                   help="slow-request capture: trip at this multiple of "
+                        "the rolling p99 e2e latency (floored at the "
+                        "latency SLO threshold when one is set)")
+    p.add_argument("--tail-min-interval", type=float, default=1.0,
+                   help="rate limit between captured tail.sample "
+                        "events, seconds")
+    p.add_argument("--tail-capacity", type=int, default=64,
+                   help="tail-sample ring size on /debugz (0 disables "
+                        "capture)")
     p.add_argument("--slo-availability", type=float, default=None,
                    metavar="PCT",
                    help="availability SLO target in percent (e.g. 99.9): "
@@ -176,6 +186,9 @@ def _liveness_kw(args) -> dict:
         "memory_guard": args.memory_guard,
         "memory_limit_bytes": args.memory_limit_bytes,
         "memory_monitor": not args.no_memory_monitor,
+        "tail_factor": args.tail_factor,
+        "tail_min_interval_s": args.tail_min_interval,
+        "tail_capacity": args.tail_capacity,
     }
 
 

@@ -51,6 +51,16 @@ span events + rate-limited metric snapshots) as schema-valid JSONL; the
 batcher crashing or a SIGTERM (``serve.__main__``) dumps it too.
 ``/debugz`` serves the flight-recorder tail, watchdog state, and the
 latest trace-attribution summary live.
+
+Tail forensics (docs/OBSERVABILITY.md "Tail forensics"): the e2e and
+span histograms record per-bucket exemplar trace ids (OpenMetrics
+``# {trace_id=...}`` on ``/metrics``), and a
+:class:`telemetry.TailWatcher` captures served requests slower than
+``max(SLO latency threshold, tail_factor x rolling p99)`` as
+rate-limited ``tail.sample`` events — full span phases plus the queue
+depth at admission, bucket/batch/pad-waste, dispatch seq, watchdog
+state, and latest attribution — joined per trace id by
+``python -m mpi4dl_tpu.analyze tail``.
 """
 
 from __future__ import annotations
@@ -111,6 +121,12 @@ class _Request:
     form_t: float = 0.0
     formed_t: float = 0.0
     staged_t: float = 0.0
+    # Tail-forensics context: the queue depth this request saw at
+    # admission and the dispatch sequence of the batch that served it —
+    # a tail.sample must say what the system looked like around the
+    # slow request, not just how slow it was.
+    queue_depth_at_submit: int = 0
+    dispatch_seq: int = -1
 
 
 class ServingEngine:
@@ -183,6 +199,16 @@ class ServingEngine:
         and ``stats()["memory"]``; None reads the device's
         ``memory_stats()`` limit (absent on CPU → the guard's peak
         check is skipped, compile-OOM refusal still applies).
+    tail_factor / tail_min_interval_s / tail_capacity: the slow-request
+        watcher (:class:`telemetry.TailWatcher`; docs/OBSERVABILITY.md
+        "Tail forensics"): a served request whose e2e latency exceeds
+        ``max(SLO latency threshold, tail_factor x rolling p99)`` is
+        captured — at most one per ``tail_min_interval_s`` — as a
+        ``tail.sample`` event (full span phases, queue depth at
+        admission, bucket/batch/pad-waste, dispatch seq, watchdog
+        state, latest attribution) into the JSONL log, the flight
+        ring, and a ``tail_capacity``-bounded ring on ``/debugz``.
+        ``tail_capacity=0`` disables capture (the A/B-overhead arm).
     """
 
     def __init__(
@@ -210,6 +236,9 @@ class ServingEngine:
         memory_monitor: bool = True,
         memory_guard: bool = False,
         memory_limit_bytes: "int | None" = None,
+        tail_factor: float = 4.0,
+        tail_min_interval_s: float = 1.0,
+        tail_capacity: int = 64,
     ):
         import jax
         import jax.numpy as jnp
@@ -385,6 +414,24 @@ class ServingEngine:
             # Prime the rolling-p99 history so the adaptive timeout is
             # meaningful before the first served request.
             self.watchdog.seed(max(self.warm_latency_s.values()))
+
+        # -- slow-request capture (telemetry/tail.py) -----------------------
+        # Seeded with the AOT warm latency (like the watchdog) and
+        # floored at the SLO latency threshold when one is declared:
+        # under an objective, "slow" never means less than the objective.
+        self.tail = telemetry.TailWatcher(
+            registry=self.registry,
+            slo_threshold_s=(
+                getattr(slo, "latency_threshold_s", None)
+                if slo is not None else None
+            ),
+            factor=tail_factor,
+            seed_s=max(self.warm_latency_s.values()),
+            min_interval_s=tail_min_interval_s,
+            capacity=tail_capacity,
+            events=self._events,
+            flight=self.flight,
+        )
 
         if self._attr_every > 0:
             # Pay the profiler backend's one-time init (~3 s measured)
@@ -599,7 +646,9 @@ class ServingEngine:
                 f"request queue full ({self._q.maxsize} waiting)",
                 retry_after_s=self.retry_after_hint(),
             ) from None
-        self._m_qdepth.set(self._q.qsize())
+        depth = self._q.qsize()
+        req.queue_depth_at_submit = depth
+        self._m_qdepth.set(depth)
         return req.future
 
     def retry_after_hint(self) -> float:
@@ -696,6 +745,7 @@ class ServingEngine:
                 self.slo.last_phase_attribution
                 if self.slo is not None else None
             ),
+            "tail": self.tail.state(),
             "flight_tail": self.flight.tail(50),
             "attribution": self.last_attribution,
         }
@@ -863,6 +913,7 @@ class ServingEngine:
         staged_t = time.monotonic()
         for r in reqs:
             r.staged_t = staged_t
+            r.dispatch_seq = seq
         with self._lock:
             self._bucket_dispatches[bucket] = (
                 self._bucket_dispatches.get(bucket, 0) + 1
@@ -974,7 +1025,7 @@ class ServingEngine:
                 self._counts["served"] += 1
                 self._latencies.append(now - r.submit_t)
             self._m_requests.inc(outcome="served")
-            self._m_latency.observe(now - r.submit_t)
+            self._m_latency.observe(now - r.submit_t, exemplar=r.trace_id)
             self._emit_spans(r, now, "served", bucket, len(reqs))
             r.future.set_result(logits[i])
         self._publish_phase_shares()
@@ -995,7 +1046,7 @@ class ServingEngine:
             ("h2d_stage", r.staged_t),
             ("device_compute", end_t),
         ])
-        telemetry.record_spans(self._m_spans, spans)
+        telemetry.record_spans(self._m_spans, spans, exemplar=r.trace_id)
         if outcome.startswith("served"):
             # Served-latency phase mix for the serve_phase_share gauges
             # (and the latency alerts' attribution baseline).
@@ -1005,6 +1056,23 @@ class ServingEngine:
                         self._phase_totals.get(s["phase"], 0.0)
                         + s["duration_s"]
                     )
+            # Slow-request capture: served AND served_late completions
+            # are offered (the late ones are the pathological tail); the
+            # watcher itself decides threshold + rate limit.
+            with self._lock:
+                padded, total = self._padded_rows, self._total_rows
+            self.tail.observe(
+                r.trace_id, end_t - r.submit_t, spans,
+                outcome=outcome, bucket=bucket, batch_size=batch_size,
+                queue_depth_at_submit=r.queue_depth_at_submit,
+                dispatch_seq=r.dispatch_seq,
+                pad_waste_ratio=padded / total if total else 0.0,
+                watchdog=(
+                    self.watchdog.state() if self.watchdog is not None
+                    else None
+                ),
+                attribution=self.last_attribution,
+            )
         if self.flight.enabled or self._events.enabled:
             ev = telemetry.span_event(
                 "serve.request", r.trace_id, spans,

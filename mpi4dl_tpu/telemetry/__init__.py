@@ -32,7 +32,11 @@ signals from. Four pieces:
 - :mod:`.alerts` — the ``pending → firing → resolved`` alert state
   machine and the daemon :class:`SLOEvaluator` behind ``/alertz``;
 - :mod:`.autoscale` — advisory fleet signals: windowed pressure →
-  the ``autoscale_desired_replicas`` gauge.
+  the ``autoscale_desired_replicas`` gauge;
+- :mod:`.tail` — slow-request capture: requests past
+  max(SLO threshold, K × rolling p99) become rate-limited
+  ``tail.sample`` events joining histogram exemplars to full span
+  forensics (``python -m mpi4dl_tpu.analyze tail``).
 
 Who publishes what: ``serve.ServingEngine`` (request outcomes, queue
 depth, bucket occupancy, pad waste, latency + lifecycle spans),
@@ -106,6 +110,7 @@ from mpi4dl_tpu.telemetry.slo import (  # noqa: F401
     availability_objective,
     latency_objective,
 )
+from mpi4dl_tpu.telemetry.tail import TailWatcher  # noqa: F401
 from mpi4dl_tpu.telemetry.windows import SnapshotWindow  # noqa: F401
 from mpi4dl_tpu.telemetry.spans import (  # noqa: F401
     chrome_trace,

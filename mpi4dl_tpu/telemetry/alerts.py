@@ -81,6 +81,31 @@ def phase_attribution(window, window_s: float) -> "dict | None":
     }
 
 
+def latency_exemplars(registry, metric: str, k: int = 5) -> "list[dict]":
+    """Top-``k`` slowest exemplars off a latency histogram's buckets
+    (value-descending, deduped by trace id): the concrete requests a
+    firing ``latency_*`` page attaches as ``evidence``. Empty when the
+    metric is absent or carries no exemplars (old snapshots, exemplar-
+    free publishers) — evidence degrades, pages still fire."""
+    m = registry.get(metric)
+    if m is None or getattr(m, "kind", None) != "histogram":
+        return []
+    best: "dict[str, dict]" = {}
+    for s in m.snapshot_series():
+        for le, ex in (s.get("exemplars") or {}).items():
+            have = best.get(ex["trace_id"])
+            if have is None or ex["value"] > have["value"]:
+                best[ex["trace_id"]] = {
+                    "trace_id": ex["trace_id"],
+                    "value": ex["value"],
+                    "ts": ex["ts"],
+                    "le": le,
+                    "labels": dict(s["labels"]),
+                }
+    out = sorted(best.values(), key=lambda e: e["value"], reverse=True)
+    return out[: int(k)]
+
+
 class AlertState:
     """One alert's ``inactive → pending → firing`` machine.
 
@@ -355,6 +380,21 @@ class SLOEvaluator:
                 ev["attrs"]["phase_attribution"] = pa
                 self.last_phase_attribution = {
                     "alert": st.name, "ts": ev["ts"], **pa,
+                }
+            # ...and its victims: the top-K exemplar trace ids off the
+            # objective's own histogram (the PR-9 breaker-evidence
+            # pattern — the page links to the concrete slow requests,
+            # `analyze tail --trace-id` takes it from there).
+            try:
+                exemplars = latency_exemplars(self.registry, obj.metric)
+            except Exception:  # noqa: BLE001 — evidence is best-effort
+                exemplars = []
+            if exemplars:
+                ev["attrs"]["evidence"] = {
+                    "exemplar_trace_ids": [
+                        e["trace_id"] for e in exemplars
+                    ],
+                    "exemplars": exemplars,
                 }
         self.transitions.append(ev)
         if self._flight is not None:

@@ -133,6 +133,18 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Structured RESOURCE_EXHAUSTED forensics (oom.report events) "
         "emitted, by program.",
     ),
+    # -- tail forensics (mpi4dl_tpu/telemetry/tail.py) -----------------------
+    "tail_samples_total": MetricSpec(
+        "counter", (),
+        "Slow requests captured as tail.sample events: e2e latency over "
+        "max(SLO latency threshold, factor x rolling p99), rate-limited.",
+    ),
+    "tail_threshold_seconds": MetricSpec(
+        "gauge", (),
+        "Live slow-request trip line of the tail watcher: max(SLO "
+        "latency threshold, factor x rolling p99 seeded with the AOT "
+        "warm latency).",
+    ),
     # -- liveness + postmortem (mpi4dl_tpu/telemetry/health.py, flight.py) ---
     "watchdog_trips_total": MetricSpec(
         "counter", (),
@@ -206,6 +218,20 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Most recent death-to-replacement-serving duration: from a "
         "replica's confirmed death to its successor joining the router "
         "(trend-tracked by the fleet_2replica bench extra).",
+    ),
+    "fleet_request_latency_seconds": MetricSpec(
+        "histogram", (),
+        "Router-observed end-to-end latency of served fleet requests "
+        "(submit -> future resolved, requeues included); buckets carry "
+        "exemplar trace ids, so the fleet p99 bucket names a real "
+        "request.",
+    ),
+    "fleet_replica_skew": MetricSpec(
+        "gauge", ("replica",),
+        "Straggler score per replica: its own e2e p99 (bucket-resolved "
+        "from the scraped /snapshotz histogram) divided by the fleet "
+        "median p99 — 1.0 = typical, >= the straggler factor trips the "
+        "replica_straggler advisory page.",
     ),
     # -- federation (mpi4dl_tpu/telemetry/federation.py) ---------------------
     "federation_replicas": MetricSpec(

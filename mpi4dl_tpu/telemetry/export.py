@@ -3,7 +3,9 @@
 :func:`render_prometheus` serializes a :class:`MetricsRegistry` in the
 Prometheus text format (version 0.0.4): ``# HELP`` / ``# TYPE`` headers,
 escaped label values, and for histograms the cumulative ``_bucket{le=}``
-series plus ``_sum``/``_count``. :class:`MetricsServer` serves it from a
+series plus ``_sum``/``_count``; buckets carrying an exemplar render the
+OpenMetrics ``# {trace_id="..."} value ts`` suffix (docs/OBSERVABILITY.md
+"Tail forensics"). :class:`MetricsServer` serves it from a
 daemon ``http.server`` thread — stdlib only (the container must not need
 ``prometheus_client``), opt-in via ``ServingEngine(metrics_port=...)`` or
 ``python -m mpi4dl_tpu.serve --metrics-port`` (port 0 binds an ephemeral
@@ -93,6 +95,20 @@ def _labels_str(labels: dict, extra: "dict | None" = None) -> str:
     return "{" + inner + "}"
 
 
+def _exemplar_suffix(ex: "dict | None") -> str:
+    """OpenMetrics exemplar suffix for a ``_bucket`` sample line:
+    ``# {trace_id="..."} value timestamp`` — the scrape-side link from a
+    latency bucket to the concrete request that most recently landed in
+    it. Empty when the bucket has none."""
+    if not ex:
+        return ""
+    tid = escape_label_value(str(ex["trace_id"]))
+    return (
+        f' # {{trace_id="{tid}"}} {_fmt_value(ex["value"])} '
+        f"{_fmt_value(ex['ts'])}"
+    )
+
+
 def render_prometheus(registry: MetricsRegistry) -> str:
     lines: list[str] = []
     for snap_name, m in registry.snapshot().items():
@@ -101,10 +117,12 @@ def render_prometheus(registry: MetricsRegistry) -> str:
         lines.append(f"# TYPE {snap_name} {m['type']}")
         for s in m["series"]:
             if m["type"] == "histogram":
+                exemplars = s.get("exemplars", {})
                 for le, cum in s["buckets"].items():
                     lines.append(
                         f"{snap_name}_bucket"
                         f"{_labels_str(s['labels'], {'le': le})} {cum}"
+                        f"{_exemplar_suffix(exemplars.get(le))}"
                     )
                 lines.append(
                     f"{snap_name}_sum{_labels_str(s['labels'])} "

@@ -15,7 +15,9 @@ snapshots into one registry-shaped view with per-replica attribution:
   what the availability SLO needs);
 - **histograms** are merged bucket-wise (cumulative ``le`` counts, sums,
   and counts add — percentile and latency-SLO math over the merged
-  buckets is exact, not an average-of-percentiles);
+  buckets is exact, not an average-of-percentiles); per-bucket exemplars
+  merge max-value-wins (the fleet bucket names its worst request), with
+  same-trace-id/different-value disagreements surfaced in ``.conflicts``;
 - **gauges** keep one series per replica under an injected ``replica``
   label (attribution: WHICH replica's queue is deep) plus ``min`` /
   ``max`` / ``sum`` rollup series (``replica="sum"`` et al — reserved
@@ -120,12 +122,17 @@ def _merge_series(out, mtype, replica, s, name, conflicts) -> None:
     else:  # histogram
         cur = acc.get(key)
         if cur is None:
-            acc[key] = {
+            entry = {
                 "labels": dict(s["labels"]),
                 "count": s["count"],
                 "sum": s["sum"],
                 "buckets": dict(s["buckets"]),
             }
+            if s.get("exemplars"):
+                entry["exemplars"] = {
+                    le: dict(ex) for le, ex in s["exemplars"].items()
+                }
+            acc[key] = entry
         elif set(cur["buckets"]) != set(s["buckets"]):
             conflicts.append(
                 f"{replica}:{name}: histogram bucket bounds disagree — "
@@ -136,7 +143,38 @@ def _merge_series(out, mtype, replica, s, name, conflicts) -> None:
             cur["sum"] += s["sum"]
             for le, n in s["buckets"].items():
                 cur["buckets"][le] += n
+            _merge_exemplars(cur, s, replica, name, conflicts)
     out["_acc"] = acc
+
+
+def _merge_exemplars(cur: dict, s: dict, replica, name, conflicts) -> None:
+    """Per-bucket exemplar merge: the MAX-value exemplar wins (the fleet
+    view should name the worst request in each bucket, not whichever
+    replica was scraped last). Two replicas presenting the SAME trace id
+    with different values for one bucket is a real disagreement — a
+    requeued request double-observed, or clock skew corrupting values —
+    surfaced in ``conflicts``, never silently averaged away (the max
+    still wins so the merge stays usable)."""
+    incoming = s.get("exemplars")
+    if not incoming:
+        return
+    mine = cur.setdefault("exemplars", {})
+    for le, ex in incoming.items():
+        have = mine.get(le)
+        if have is None:
+            mine[le] = dict(ex)
+            continue
+        if (
+            have["trace_id"] == ex["trace_id"]
+            and have["value"] != ex["value"]
+        ):
+            conflicts.append(
+                f"{replica}:{name}: bucket le={le} exemplar "
+                f"{ex['trace_id']!r} reported with conflicting values "
+                f"({have['value']:g} vs {ex['value']:g}) — max kept"
+            )
+        if ex["value"] > have["value"]:
+            mine[le] = dict(ex)
 
 
 def _finalize(acc: dict, mtype: str) -> "list[dict]":
@@ -156,6 +194,89 @@ def _finalize(acc: dict, mtype: str) -> "list[dict]":
                 "labels": {**per["labels"], "replica": roll}, "value": v,
             })
     return series
+
+
+def bucket_quantile(buckets: dict, q: float) -> "float | None":
+    """Conservative quantile from cumulative ``le`` buckets: the smallest
+    finite bound covering at least fraction ``q`` of observations. When
+    the quantile lands in ``+Inf`` the largest finite bound is returned —
+    a FLOOR ("p99 is at least this"), which is the safe direction for
+    straggler scoring: a replica whose tail escapes the bucket range can
+    only be under-scored relative to itself, never over-score a healthy
+    peer. None with no observations."""
+    total = buckets.get("+Inf", 0)
+    if total <= 0:
+        return None
+    need = q * total
+    finite = sorted(
+        (float(le) for le in buckets if le != "+Inf")
+    )
+    for b in finite:
+        if buckets[f"{b:g}"] >= need:
+            return b
+    return finite[-1] if finite else None
+
+
+def replica_skew(
+    children: "dict[str, dict]",
+    metric: str = "serve_request_latency_seconds",
+    quantile: float = 0.99,
+    min_count: int = 20,
+) -> dict:
+    """Straggler scoring over the per-replica snapshots the aggregator
+    already scraped (the merge collapses histograms fleet-wide; the
+    per-replica tails live in the raw children): each replica's own
+    bucket-resolved p99 of ``metric``, divided by the fleet MEDIAN p99.
+
+    Median, not mean: one straggler must not drag the baseline toward
+    itself — with a median the slow replica scores against what the
+    healthy majority actually delivers. Even replica counts use the
+    LOWER median: the interpolated midpoint of a 2-replica fleet sits
+    halfway to the straggler, capping its own skew just under 2x no
+    matter how slow it gets — leaning the baseline toward the faster
+    half keeps the smallest fleets able to name their straggler.
+    Replicas with fewer than ``min_count`` observations are excluded (a
+    replica that served three requests has no tail to score).
+
+    Returns ``{"p99": {replica: p99_s}, "median_p99": m,
+    "skew": {replica: p99/m}, "excluded": [names]}`` — empty maps when
+    fewer than two replicas qualify (skew needs a fleet to be relative
+    to)."""
+    p99s: "dict[str, float]" = {}
+    excluded: "list[str]" = []
+    for name in sorted(children):
+        m = children[name].get(metric)
+        if not m or m.get("type") != "histogram":
+            excluded.append(name)
+            continue
+        agg: "dict[str, float]" = {}
+        for s in m.get("series", ()):
+            for le, cum in s.get("buckets", {}).items():
+                agg[le] = agg.get(le, 0) + cum
+        if agg.get("+Inf", 0) < min_count:
+            excluded.append(name)
+            continue
+        p = bucket_quantile(agg, quantile)
+        if p is None:
+            excluded.append(name)
+            continue
+        p99s[name] = p
+    if len(p99s) < 2:
+        return {"p99": p99s, "median_p99": None, "skew": {},
+                "excluded": excluded}
+    ordered = sorted(p99s.values())
+    median = ordered[(len(ordered) - 1) // 2]  # lower median, see above
+    if median <= 0:
+        # All-zero tails (every observation under the first bucket):
+        # nobody is a straggler relative to anything.
+        return {"p99": p99s, "median_p99": median, "skew": {},
+                "excluded": excluded}
+    return {
+        "p99": p99s,
+        "median_p99": median,
+        "skew": {name: p / median for name, p in p99s.items()},
+        "excluded": excluded,
+    }
 
 
 class _MergedMetricView:
@@ -296,6 +417,22 @@ class FederatedAggregator:
         thresholds (sum of the replicas' ``max_queue``).
     interval_s / timeout_s: scrape cadence (daemon thread via
         :meth:`start`) and per-replica HTTP timeout.
+    straggler_factor / straggler_min_count: fleet straggler detection
+        (:func:`replica_skew`): every scrape scores each replica's own
+        e2e p99 against the fleet median and publishes the cataloged
+        ``fleet_replica_skew{replica=}`` gauge; a replica whose skew
+        reaches ``straggler_factor`` trips the advisory
+        ``replica_straggler`` page (stock :class:`AlertState` →
+        ``alert_active`` + ``alert.transition`` naming the replica, on
+        ``/alertz``). The default factor (4.0) is TWO default-histogram
+        buckets of separation: bucket-resolved p99s are quantized and
+        adjacent default bounds sit 2-2.5x apart, so any factor ≤2.5
+        would page on one-bucket noise between healthy replicas.
+        ``straggler_factor=None`` disables the alert (the gauge still
+        publishes). Replicas with fewer than ``straggler_min_count``
+        served observations are not scored.
+    events: optional :class:`JsonlWriter` for ``alert.transition``
+        events (the straggler page's paper trail).
     clock: injectable for deterministic tests (drives the evaluator's
         snapshot ring too).
     """
@@ -308,6 +445,9 @@ class FederatedAggregator:
         queue_capacity: int = 64,
         interval_s: float = 1.0,
         timeout_s: float = 2.0,
+        straggler_factor: "float | None" = 4.0,
+        straggler_min_count: int = 20,
+        events=None,
         clock=time.monotonic,
         start: bool = False,
     ):
@@ -320,6 +460,7 @@ class FederatedAggregator:
         self._targets: "dict[str, ReplicaTarget]" = {}
         self._lock = threading.Lock()
         self.conflicts: "list[str]" = []
+        self._events = events
         self._m_replicas = telemetry.declare(
             self.registry, "federation_replicas"
         )
@@ -328,6 +469,22 @@ class FederatedAggregator:
         )
         self._m_replicas.set(0, state="configured")
         self._m_replicas.set(0, state="up")
+        # Straggler detection: gauge + advisory alert machinery.
+        self.straggler_factor = (
+            float(straggler_factor) if straggler_factor is not None else None
+        )
+        self.straggler_min_count = int(straggler_min_count)
+        self._m_skew = telemetry.declare(self.registry, "fleet_replica_skew")
+        self._m_alert = telemetry.declare(self.registry, "alert_active")
+        self.straggler_alert = telemetry.AlertState(
+            "replica_straggler", "page", for_s=0.0
+        )
+        self._m_alert.set(
+            0.0, alert=self.straggler_alert.name,
+            severity=self.straggler_alert.severity,
+        )
+        self.last_skew: dict = {}
+        self.straggler_transitions: "list[dict]" = []
         for name, url in (replicas or {}).items():
             self.add_replica(name, url)
 
@@ -404,20 +561,71 @@ class FederatedAggregator:
                 self._m_scrapes.inc(replica=t.name, outcome="error")
             if t.consecutive_failures == 0 and t.snapshot is not None:
                 up += 1
-        merged, conflicts = merge_snapshots({
+        children = {
             t.name: t.snapshot
             for t in self.replicas()
             if t.snapshot is not None
-        })
+        }
+        merged, conflicts = merge_snapshots(children)
         self.registry.set_merged(merged)
         self.conflicts = conflicts
         self._m_replicas.set(up, state="up")
+        self._evaluate_straggler(children, now)
         if self.slo is not None:
             try:
                 self.slo.evaluate_once(now)
             except Exception:  # noqa: BLE001 — fleet evaluation is a
                 pass  # sidecar; the scrape loop must survive it
         return merged
+
+    def _evaluate_straggler(self, children: dict, now: float) -> None:
+        """Per-replica skew scoring + the advisory ``replica_straggler``
+        page. Scored from the RAW per-replica snapshots (the merge
+        collapses the histograms), published on the aggregator's local
+        registry so the gauge scrapes with the merged view."""
+        skew = replica_skew(children, min_count=self.straggler_min_count)
+        self.last_skew = skew
+        for name, v in skew["skew"].items():
+            self._m_skew.set(v, replica=name)
+        if self.straggler_factor is None:
+            return
+        worst = max(
+            skew["skew"], key=lambda n: skew["skew"][n], default=None
+        )
+        active = (
+            worst is not None
+            and skew["skew"][worst] >= self.straggler_factor
+        )
+        st = self.straggler_alert
+        moved = st.step(active, now)
+        self._m_alert.set(
+            1.0 if st.state == "firing" else 0.0,
+            alert=st.name, severity=st.severity,
+        )
+        if moved is None:
+            return
+        ev = {
+            "ts": time.time(),
+            "kind": "event",
+            "name": "alert.transition",
+            "attrs": {
+                "alert": st.name,
+                "severity": st.severity,
+                "from": moved[0],
+                "to": moved[1],
+                # The page names its suspect: WHICH replica drags the
+                # fleet tail, by how much, against what baseline.
+                "replica": worst,
+                "skew": skew["skew"].get(worst) if worst else None,
+                "replica_p99_s": skew["p99"].get(worst) if worst else None,
+                "fleet_median_p99_s": skew["median_p99"],
+                "factor": self.straggler_factor,
+            },
+        }
+        self.straggler_transitions.append(ev)
+        del self.straggler_transitions[:-64]
+        if self._events is not None and getattr(self._events, "enabled", False):
+            self._events.write(ev)
 
     # -- surfaces -------------------------------------------------------------
 
@@ -437,22 +645,55 @@ class FederatedAggregator:
             "replicas": [t.state() for t in self.replicas()],
             "conflicts": list(self.conflicts),
             "interval_s": self.interval_s,
+            "straggler": self.straggler_state(),
             "slo": self.slo.state() if self.slo is not None else None,
         }
+
+    def straggler_state(self) -> dict:
+        return {
+            "factor": self.straggler_factor,
+            "min_count": self.straggler_min_count,
+            "skew": dict(self.last_skew.get("skew", {})),
+            "p99": dict(self.last_skew.get("p99", {})),
+            "median_p99_s": self.last_skew.get("median_p99"),
+            "alert": self.straggler_alert.snapshot(),
+            "transitions": list(self.straggler_transitions)[-20:],
+        }
+
+    def alertz_state(self) -> dict:
+        """The fleet ``/alertz`` payload: the SLO evaluator's state (when
+        configured) with the straggler alert folded into the same
+        ``alerts`` / ``transitions`` lists — one page surface, one
+        runbook shape."""
+        base = (
+            self.slo.state() if self.slo is not None
+            else {"slos": [], "alerts": [], "transitions": [],
+                  "phase_attribution": None, "autoscale": None}
+        )
+        base["alerts"] = list(base.get("alerts", ())) + [
+            self.straggler_alert.snapshot()
+        ]
+        base["transitions"] = (
+            list(base.get("transitions", ()))
+            + list(self.straggler_transitions)[-20:]
+        )
+        base["straggler"] = self.straggler_state()
+        return base
 
     def serve(self, port: int = 0, host: str = "127.0.0.1"):
         """Expose the federated view as its own scrape surface
         (``/metrics`` + ``/snapshotz`` over the merged registry — so
         federation composes hierarchically — ``/healthz`` from the
         aggregated replica health, ``/debugz`` with scrape state, and
-        ``/alertz`` when a fleet SLO is configured)."""
+        ``/alertz``: the fleet SLO state when configured, always the
+        straggler alert)."""
         from mpi4dl_tpu.telemetry.export import MetricsServer
 
         self.server = MetricsServer(
             self.registry, port=port, host=host,
             health=self.health_snapshot,
             debug=self.state,
-            alerts=self.slo.state if self.slo is not None else None,
+            alerts=self.alertz_state,
         )
         return self.server
 

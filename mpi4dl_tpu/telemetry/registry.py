@@ -23,6 +23,15 @@ select the series (``counter.inc(1, outcome="served")``). Registering the
 same name twice returns the existing metric when type/labels/help agree
 and raises when they don't — two subsystems silently disagreeing about
 what a name means is exactly the bug a registry exists to prevent.
+
+Histograms additionally carry OpenMetrics-style **exemplars**: an
+``observe(value, exemplar=trace_id)`` retains, per bucket, the most
+recent ``(trace_id, value, ts)`` — the aggregate→instance link that lets
+a scrape answer "which request landed in the p99 bucket" with a real
+trace id instead of a distribution (docs/OBSERVABILITY.md "Tail
+forensics"). Exemplars ride ``snapshot_series()`` (so ``/snapshotz`` and
+the federation merge carry them) and render as ``# {trace_id="..."}``
+suffixes in the text exposition (:mod:`mpi4dl_tpu.telemetry.export`).
 """
 
 from __future__ import annotations
@@ -30,6 +39,7 @@ from __future__ import annotations
 import random
 import re
 import threading
+import time
 from typing import Iterable, Sequence
 
 from mpi4dl_tpu.profiling import percentiles
@@ -180,12 +190,26 @@ class Histogram(_Metric):
                 "sum": 0.0,
                 "count": 0,
                 "reservoir": Reservoir(),
+                # Per-bucket most-recent exemplar ({trace_id, value, ts}
+                # or None), +Inf last like bucket_counts.
+                "exemplars": [None] * (len(self.buckets) + 1),
             }
         return st
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(
+        self, value: float, exemplar: "str | None" = None, **labels
+    ) -> None:
+        """Record one observation. ``exemplar`` (a trace id) tags the
+        bucket the value lands in with ``{trace_id, value, ts}`` — most
+        recent wins; the aggregate→instance link a scrape follows from a
+        latency bucket back to a concrete request."""
         key = _check_labels(self.labelnames, labels)
         value = float(value)
+        ex = (
+            {"trace_id": str(exemplar), "value": value, "ts": time.time()}
+            if exemplar
+            else None
+        )
         with self._lock:
             st = self._state(key)
             st["sum"] += value
@@ -194,8 +218,12 @@ class Histogram(_Metric):
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     st["bucket_counts"][i] += 1
+                    if ex is not None:
+                        st["exemplars"][i] = ex
                     return
             st["bucket_counts"][-1] += 1
+            if ex is not None:
+                st["exemplars"][-1] = ex
 
     def percentiles(self, pcts=(50, 90, 99), **labels) -> dict:
         key = _check_labels(self.labelnames, labels)
@@ -212,6 +240,7 @@ class Histogram(_Metric):
                     "sum": st["sum"],
                     "count": st["count"],
                     "vals": list(st["reservoir"].values),
+                    "exemplars": list(st["exemplars"]),
                 })
                 for k, st in self._series.items()
             ]
@@ -222,13 +251,22 @@ class Histogram(_Metric):
                 cum += n
                 buckets[f"{bound:g}"] = cum
             buckets["+Inf"] = cum + st["counts"][-1]
-            out.append({
+            bounds = [f"{b:g}" for b in self.buckets] + ["+Inf"]
+            exemplars = {
+                le: dict(ex)
+                for le, ex in zip(bounds, st["exemplars"])
+                if ex is not None
+            }
+            entry = {
                 "labels": dict(zip(self.labelnames, k)),
                 "count": st["count"],
                 "sum": st["sum"],
                 "buckets": buckets,
                 "percentiles": percentiles(st["vals"]),
-            })
+            }
+            if exemplars:  # sparse: buckets with no exemplar carry no key
+                entry["exemplars"] = exemplars
+            out.append(entry)
         return out
 
 

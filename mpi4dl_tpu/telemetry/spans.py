@@ -113,12 +113,16 @@ def span_event(
     }
 
 
-def record_spans(histogram, spans: "list[dict]") -> None:
+def record_spans(
+    histogram, spans: "list[dict]", exemplar: "str | None" = None
+) -> None:
     """Mirror span durations into a phase-labeled histogram (the catalog's
     ``serve_span_seconds``) so the per-phase distribution is scrapeable
-    without replaying the JSONL log."""
+    without replaying the JSONL log. ``exemplar`` (the request's trace
+    id) tags each phase bucket the durations land in, so a scrape links
+    a slow ``queue_wait`` bucket straight to a concrete request."""
     for s in spans:
-        histogram.observe(s["duration_s"], phase=s["phase"])
+        histogram.observe(s["duration_s"], exemplar=exemplar, phase=s["phase"])
 
 
 # -- joining + export across processes ----------------------------------------

@@ -598,7 +598,7 @@ class Trainer:
                 self.remat == "scanq"
                 and len(run) >= 3
                 and ckpt is not _no_ckpt
-                and not self._scanq_store_granted(run, hc)
+                and not self._scanq_store_granted(run, params, x)
             ):
                 # Anchored-quadratic backward: O(1) live boundaries per
                 # run (the >3072px policy — chain_quadratic docstring).
@@ -621,15 +621,25 @@ class Trainer:
             h = self._restore(hc, shapes)
         return h
 
-    def _scanq_store_granted(self, run, hc) -> bool:
+    def _scanq_store_granted(self, run, params, x) -> bool:
         """``MPI4DL_TPU_SCANQ_STORE_MB`` (default 0 = off): under "scanq",
         runs whose full carry set (len(run) x compact carry bytes) fits
         the budget keep the plain checkpointed scan — storing a cheap
         run's carries avoids its quadratic recompute while the expensive
-        runs stay anchored. Budget is consumed front-to-back per trace
-        (late small-activation stages free their carries before the early
-        stages' backward runs, so granting them is usually safe). A pure
-        scheduling choice; golden-tested with the budget set."""
+        runs stay anchored. The budget is granted BACK-TO-FRONT over the
+        scan plan (decided for every eligible run at the first call of a
+        trace, via the same abstract shape walk as ``_budgeted_ckpts``):
+        the late small-activation stages free their stored carries before
+        the early stages' backward runs, so they are the safe grants —
+        and the cheapest, so the budget covers more runs. (ADVICE-r5:
+        consuming the budget front-to-back handed the storage to the
+        EARLIEST fitting run — the opposite of this rationale.) A pure
+        scheduling choice; golden-tested with the budget set.
+
+        Caveat: a granted run later downgraded to the no-checkpoint tier
+        by ``_nockpt_grants`` (both budgets set at once) keeps its
+        deduction — the unused reservation wastes budget, never
+        correctness."""
         budget_mb = float(os.environ.get("MPI4DL_TPU_SCANQ_STORE_MB", "0"))
         if budget_mb <= 0:
             return False
@@ -637,25 +647,38 @@ class Trainer:
         # scan plan), NOT by carry shape: two distinct same-shaped runs
         # must EACH deduct the budget, while retraces of the same plan
         # must reuse the original decision.
-        key = run[0]
         if getattr(self, "_scanq_budget_key", None) != self._scan_plan_key:
             self._scanq_budget_key = self._scan_plan_key
-            self._scanq_budget_left = budget_mb * 1e6
             self._scanq_grants = {}
             self._scanq_grant_bytes = {}
-        if key not in self._scanq_grants:
-            carry_bytes = sum(
-                int(np.prod(a.shape)) * a.dtype.itemsize
-                for a in jax.tree.leaves(hc)
-            ) * len(run)
-            granted = carry_bytes <= self._scanq_budget_left
-            if granted:
-                self._scanq_budget_left -= carry_bytes
-                # Recorded per run for the analyzer's remat-effectiveness
-                # rule (Trainer.remat_report): grants vs budget vs peak.
-                self._scanq_grant_bytes[key] = carry_bytes
-            self._scanq_grants[key] = granted
-        return self._scanq_grants[key]
+            # Abstract walk over the plan (same shape math as the
+            # planner / _budgeted_ckpts: _at_join then per-cell
+            # eval_shape) — the carry at a run's input has the same byte
+            # count compacted or not.
+            carry_bytes_at: dict[int, int] = {}
+            h = jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+            for r in self._scan_plan:
+                h = self._at_join(r[0], h)
+                carry_bytes_at[r[0]] = sum(
+                    int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in jax.tree.leaves(h)
+                ) * len(r)
+                for k in r:
+                    h = jax.eval_shape(self.cells[k].apply, params[k], h)
+            left = budget_mb * 1e6
+            for r in reversed(self._scan_plan):
+                if len(r) < 3:
+                    continue  # short runs never take the scanq path
+                granted = carry_bytes_at[r[0]] <= left
+                if granted:
+                    left -= carry_bytes_at[r[0]]
+                    # Recorded per run for the analyzer's remat-
+                    # effectiveness rule (Trainer.remat_report):
+                    # grants vs budget vs peak.
+                    self._scanq_grant_bytes[r[0]] = carry_bytes_at[r[0]]
+                self._scanq_grants[r[0]] = granted
+            self._scanq_budget_left = left
+        return self._scanq_grants.get(run[0], False)
 
     def _run_cell(self, i, p, h):
         """Apply cell ``i`` (inserting the SP→LP tile merge before cell

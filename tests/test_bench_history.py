@@ -107,6 +107,49 @@ def test_fleet_recovery_series_trended_and_inverted(tmp_path):
     assert by_key["fleet_2replica.recovery_s"]["verdict"] == "regressed"
 
 
+def test_tail_ratio_trended_and_inverted(tmp_path):
+    """ISSUE 10 CI satellite: the serving extra's tail summary
+    (p99/p50 ratio) becomes a trend series with the regression sign
+    inverted — a GROWING tail fails CI even when mean throughput holds."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def with_tail(ratio):
+        r = _result(7.0, 0.5)
+        r["extras"]["serving_amoebanet3_32px"] = {
+            "value": 2000.0,
+            "tail": {"p99_p50_ratio": ratio, "samples": 3,
+                     "threshold_ms": 45.0},
+        }
+        return r
+
+    s = extract_series(with_tail(1.8))
+    assert s["serving_amoebanet3_32px"] == 2000.0
+    assert s["serving_amoebanet3_32px.tail_p99_p50_ratio"] == 1.8
+    assert lower_is_better("serving_amoebanet3_32px.tail_p99_p50_ratio")
+    assert not lower_is_better("serving_amoebanet3_32px")
+
+    # Same throughput, fatter tail: CI-visible regression.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_tail(1.8)), _round(2, 0, with_tail(2.4)),
+    ])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(
+             paths, [with_tail(1.8), with_tail(2.4)]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key[
+        "serving_amoebanet3_32px.tail_p99_p50_ratio"
+    ]["verdict"] == "regressed"
+    # A shrinking tail is the improvement direction.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_tail(2.4)), _round(2, 0, with_tail(1.8)),
+    ])
+    assert main(paths) == 0
+
+
 def test_peak_hbm_series_regresses_on_growth(tmp_path):
     """ISSUE satellite: memory series get the SAME verdict treatment as
     throughput — tolerance band, compare against the last round that

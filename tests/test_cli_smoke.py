@@ -154,6 +154,51 @@ def test_analyze_trace_export_cli(tmp_path, capsys):
     assert main(["trace-export", str(log), "--trace-id", "missing"]) == 1
 
 
+def test_analyze_tail_cli(tmp_path, capsys):
+    """ISSUE 10 CI satellite: `python -m mpi4dl_tpu.analyze tail` through
+    the analysis CLI's real dispatch — pure JSON, pre-jax, fast tier.
+    Canned logs: two span populations + a tail.sample + an exemplar-
+    carrying metrics event; the deep joins are covered in test_tail.py."""
+    from mpi4dl_tpu import telemetry
+    from mpi4dl_tpu.analysis.cli import main
+
+    log = tmp_path / "telemetry-tail.jsonl"
+    reg = telemetry.MetricsRegistry()
+    telemetry.declare(reg, "serve_request_latency_seconds").observe(
+        0.5, exemplar="t-slow"
+    )
+    with open(log, "w") as f:
+        for tid, e2e in (("t-slow", 0.5), ("t-fast", 0.01)):
+            ev = telemetry.span_event(
+                "serve.request", tid,
+                telemetry.spans_from_marks([
+                    ("submit", 1.0), ("queue_wait", 1.0 + e2e / 2),
+                    ("device_compute", 1.0 + e2e),
+                ]),
+                attrs={"pid": 7, "role": "engine", "outcome": "served",
+                       "e2e_latency_s": e2e},
+                ts=100.0,
+            )
+            f.write(json.dumps(ev) + "\n")
+        f.write(json.dumps({
+            "ts": 100.1, "kind": "event", "name": "tail.sample",
+            "attrs": {"trace_id": "t-slow", "e2e_latency_s": 0.5,
+                      "threshold_s": 0.04, "pid": 7},
+        }) + "\n")
+        f.write(json.dumps(telemetry.metrics_event(reg, ts=101.0)) + "\n")
+
+    assert main(["tail", str(log), "--top", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "t-slow" in out and "t-fast" in out
+    assert main(["tail", str(log), "--trace-id", "t-slow"]) == 0
+    out = capsys.readouterr().out
+    assert "dominant phase" in out and "tail.sample" in out
+    assert "exemplar: serve_request_latency_seconds" in out
+    assert main(["tail", str(log), "--list-exemplars"]) == 0
+    assert "t-slow" in capsys.readouterr().out
+    assert main(["tail", str(log), "--trace-id", "missing"]) == 1
+
+
 def test_fleet_cli_plan_smoke(capsys):
     """ISSUE CI satellite: `python -m mpi4dl_tpu.fleet --plan` — the
     pure-dispatch path: chaos specs parsed + validated, the fleet plan

@@ -46,6 +46,12 @@ def test_chaos_spec_parsing_goldens():
         "delay-scrape", 1, 3.0, 2.0
     )
     assert op.describe() == "delay-scrape:r1=3s@+2s"
+    # ISSUE 10: the straggler drill — slow a replica's SERVING path.
+    op = parse_chaos_spec("delay:1=0.3@2")
+    assert (op.action, op.target, op.seconds, op.at_s) == (
+        "delay", 1, 0.3, 2.0
+    )
+    assert op.describe() == "delay:r1=0.3s@+2s"
 
 
 def test_chaos_spec_errors():
@@ -556,6 +562,154 @@ def test_supervise_windowed_breaker_gives_up(tmp_path):
     )
     assert rc == 7
     assert any("within 300s" in m for m in msgs)
+
+
+# -- the straggler chaos drill (ISSUE 10) -------------------------------------
+
+
+def test_fleet_chaos_delay_drill_flags_straggler(tmp_path):
+    """ISSUE 10 satellite: 2 real replica workers under router load, the
+    chaos ``delay`` action slows r1's serving path mid-run — r1 stays
+    HEALTHY (keeps serving, /healthz green, nothing restarts it), and
+    only the federation-side skew scoring names it:
+    ``fleet_replica_skew{replica="r1"}`` over the straggler factor, the
+    ``replica_straggler`` advisory page firing on the aggregator's
+    /alertz with a transition naming r1, and the router's fleet latency
+    histogram carrying exemplar trace ids for the slow bucket."""
+    from mpi4dl_tpu.fleet.chaos import inject, parse_chaos_spec
+    from mpi4dl_tpu.fleet.replica import ReplicaProcess, worker_cmd
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.telemetry.federation import FederatedAggregator
+
+    tele = str(tmp_path / "tele")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    procs = [
+        ReplicaProcess(
+            f"r{i}",
+            worker_cmd(["--image-size", "16", "--max-batch", "2",
+                        "--telemetry-dir", tele]),
+            base_dir=str(tmp_path / "fleet"),
+            env=env,
+            log_path=str(tmp_path / f"r{i}.log"),
+        )
+        for i in range(2)
+    ]
+    router = Router(
+        example_shape=(16, 16, 3), inflight_per_replica=4,
+        health_interval_s=0.1, telemetry_dir=tele,
+    )
+    agg = None
+    try:
+        for p in procs:
+            p.spawn()
+        ports = [p.wait_ready(timeout_s=420.0) for p in procs]
+        for p, pp in zip(procs, ports):
+            router.add_replica(
+                p.name,
+                f"http://127.0.0.1:{pp['predict_port']}",
+                f"http://127.0.0.1:{pp['metrics_port']}",
+            )
+        agg = FederatedAggregator(
+            replicas={
+                p.name: f"http://127.0.0.1:{pp['metrics_port']}"
+                for p, pp in zip(procs, ports)
+            },
+            straggler_factor=4.0, straggler_min_count=20,
+        )
+        x = np.zeros((16, 16, 3), np.float32)
+
+        # Phase 1 — healthy baseline: both replicas serve, nobody skews.
+        rep = run_closed_loop(router, 80, concurrency=8, deadline_s=60.0)
+        assert rep["served"] == 80 and rep["errors"] == 0
+        agg.scrape_once()
+        assert agg.straggler_alert.state == "inactive"
+
+        # Phase 2 — inject the delay through the real chaos plumbing
+        # (spec grammar → /chaos → delay_predict), via a stub supervisor
+        # exposing slot_by_index like the CLI's.
+        class _Slots:
+            def slot_by_index(self, i):
+                import types
+
+                p = procs[i]
+                return types.SimpleNamespace(
+                    name=p.name, pid=p.pid,
+                    client=router._replicas[p.name].client,
+                )
+
+        # 1 s/batch: far above the shared CPU box's own tail noise, so
+        # the straggler's p99 bucket separates from the healthy
+        # replica's under any load jitter.
+        record = inject(parse_chaos_spec("delay:1=1"), _Slots())
+        assert record["applied"] == "delay_predict"
+
+        rep = run_closed_loop(router, 40, concurrency=8, deadline_s=60.0)
+        assert rep["served"] == 40 and rep["errors"] == 0  # slow, not down
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            agg.scrape_once()
+            skew = agg.last_skew.get("skew", {})
+            if skew.get("r1", 0) >= 4.0:
+                break
+            # The delayed replica keeps absorbing a trickle (health says
+            # yes), so its own histogram keeps inflating.
+            run_closed_loop(router, 16, concurrency=4, deadline_s=60.0)
+        skew = agg.last_skew["skew"]
+        assert skew.get("r1", 0) >= 4.0, agg.last_skew
+        assert skew.get("r0", 99) < 4.0, agg.last_skew
+
+        # The gauge + the page, fleet-side.
+        assert agg.registry.get("fleet_replica_skew").value(
+            replica="r1"
+        ) >= 4.0
+        assert agg.straggler_alert.state == "firing"
+        (t,) = [
+            tr for tr in agg.straggler_transitions
+            if tr["attrs"]["to"] == "firing"
+        ]
+        assert t["attrs"]["replica"] == "r1"
+        srv = agg.serve(port=0)
+        import urllib.request
+
+        alertz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/alertz", timeout=10
+        ).read())
+        assert any(
+            a["name"] == "replica_straggler" and a["state"] == "firing"
+            for a in alertz["alerts"]
+        )
+
+        # The straggler is HEALTHY the whole time — this failure shape
+        # is invisible to every liveness signal the stack had before.
+        assert router._replicas["r1"].healthy
+        assert procs[1].alive()
+
+        # Router-side: the fleet histogram carries exemplars, and the
+        # slow bucket's exemplar is a real trace id (the analyze-tail
+        # entry point).
+        (series,) = router.registry.get(
+            "fleet_request_latency_seconds"
+        ).snapshot_series()
+        assert series["exemplars"]
+        worst = max(
+            series["exemplars"].values(), key=lambda e: e["value"]
+        )
+        assert worst["value"] >= 1.0  # a delayed request tops the map
+        # The exemplar is a real loadgen-minted id ("client-<pid>-...");
+        # the router only mints its own ("fleet-...") for callers that
+        # pass none.
+        assert worst["trace_id"].startswith(("client-", "fleet-"))
+        assert len(worst["trace_id"].split("-")) == 4
+    finally:
+        if agg is not None:
+            agg.close()
+        router.stop(drain=False)
+        for p in procs:
+            p.terminate(wait_s=10.0)
 
 
 # -- the tier-1 chaos drill ---------------------------------------------------

@@ -1,0 +1,621 @@
+"""Tail-latency forensics (ISSUE 10 tentpole): histogram exemplars
+(observe → snapshot → OpenMetrics render → federation merge, max-wins +
+conflict surfacing), the slow-request TailWatcher (threshold math, rate
+limiting, schema-valid tail.sample capture), fleet straggler detection
+(replica_skew scoring + the replica_straggler advisory page over live
+/snapshotz endpoints), latency-alert exemplar evidence, and the
+``analyze tail`` CLI joining all three artifacts per trace id.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.telemetry.alerts import latency_exemplars
+from mpi4dl_tpu.telemetry.federation import (
+    FederatedAggregator,
+    bucket_quantile,
+    merge_snapshots,
+    replica_skew,
+)
+from mpi4dl_tpu.telemetry.tail import TailWatcher
+
+
+# -- exemplar semantics (registry) --------------------------------------------
+
+
+def test_histogram_exemplar_most_recent_per_bucket():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("lat", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="t-1")
+    h.observe(0.06, exemplar="t-2")   # same bucket: most recent wins
+    h.observe(0.5, exemplar="t-3")
+    h.observe(5.0, exemplar="t-inf")  # +Inf bucket
+    h.observe(0.07)                   # exemplar-less: leaves t-2 in place
+    (s,) = h.snapshot_series()
+    ex = s["exemplars"]
+    assert ex["0.1"]["trace_id"] == "t-2"
+    assert ex["0.1"]["value"] == 0.06
+    assert ex["1"]["trace_id"] == "t-3"
+    assert ex["+Inf"]["trace_id"] == "t-inf"
+    assert ex["0.1"]["ts"] > 0
+    # Labeled series keep independent exemplars.
+    h2 = reg.histogram("spans", "h", labels=("phase",), buckets=(0.1,))
+    h2.observe(0.05, exemplar="a", phase="queue")
+    h2.observe(0.05, exemplar="b", phase="compute")
+    by_phase = {
+        s["labels"]["phase"]: s["exemplars"]["0.1"]["trace_id"]
+        for s in h2.snapshot_series()
+    }
+    assert by_phase == {"queue": "a", "compute": "b"}
+    # No exemplars ever observed → no key at all (sparse, not empty).
+    h3 = reg.histogram("plain", "h", buckets=(0.1,))
+    h3.observe(0.05)
+    (s3,) = h3.snapshot_series()
+    assert "exemplars" not in s3
+    # Snapshots with exemplars stay schema-valid.
+    telemetry.validate_event(telemetry.metrics_event(reg))
+
+
+def test_exemplar_openmetrics_render_and_escaping_round_trip():
+    """ISSUE satellite: the text exposition renders bucket exemplars as
+    OpenMetrics ``# {trace_id="..."} value ts`` suffixes, with label-value
+    escaping that survives a round trip even for hostile trace ids."""
+    from mpi4dl_tpu.telemetry.export import unescape_label_value
+
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("lat", "h", buckets=(0.1,))
+    nasty = 'id"with\\quote\nand-newline'
+    h.observe(0.05, exemplar=nasty)
+    h.observe(5.0)  # +Inf bucket: count but no exemplar
+    text = telemetry.render_prometheus(reg)
+    lines = [l for l in text.splitlines() if l.startswith("lat_bucket")]
+    (with_ex,) = [l for l in lines if "#" in l]
+    assert with_ex.startswith('lat_bucket{le="0.1"} 1 # {trace_id="')
+    # The exemplar suffix is a single line and the id parses back exactly.
+    quoted = with_ex[
+        with_ex.index('trace_id="') + len('trace_id="'):with_ex.rindex('"}')
+    ]
+    assert unescape_label_value(quoted) == nasty
+    # Buckets without exemplars render the plain 0.0.4 sample line.
+    (plain,) = [l for l in lines if "+Inf" in l]
+    assert "#" not in plain and plain.endswith(" 2")
+
+
+# -- federation merge ---------------------------------------------------------
+
+
+def _hist_child(latencies, trace_prefix, buckets=(0.1, 1.0)):
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram(
+        "serve_request_latency_seconds", "h", buckets=buckets
+    )
+    for i, v in enumerate(latencies):
+        h.observe(v, exemplar=f"{trace_prefix}-{i}")
+    return reg.snapshot()
+
+
+def test_merge_exemplars_max_value_wins_per_bucket():
+    """ISSUE tentpole golden: /snapshotz-shaped children merge their
+    per-bucket exemplars MAX-VALUE-wins — the fleet bucket names its
+    worst request, regardless of replica order."""
+    a = _hist_child([0.05, 0.5], "a")     # a-0 in le=0.1, a-1 in le=1
+    b = _hist_child([0.09, 0.2], "b")     # b-0 in le=0.1, b-1 in le=1
+    merged, conflicts = merge_snapshots({"r0": a, "r1": b})
+    assert conflicts == []
+    (s,) = merged["serve_request_latency_seconds"]["series"]
+    assert s["count"] == 4  # bucket-wise histogram merge unchanged
+    assert s["exemplars"]["0.1"]["trace_id"] == "b-0"   # 0.09 > 0.05
+    assert s["exemplars"]["1"]["trace_id"] == "a-1"     # 0.5 > 0.2
+    # Replica order must not matter.
+    merged2, _ = merge_snapshots({"r0": b, "r1": a})
+    assert (
+        merged2["serve_request_latency_seconds"]["series"][0]["exemplars"]
+        == s["exemplars"]
+    )
+
+
+def test_merge_exemplar_conflict_surfaced_not_missummed():
+    """Same trace id, same bucket, DIFFERENT values across replicas (a
+    double-observed requeue, or clock skew): the merge keeps the max but
+    surfaces the disagreement in conflicts instead of averaging."""
+    a = _hist_child([0.05], "dup")
+    b = _hist_child([0.09], "dup")  # dup-0 again, different value
+    merged, conflicts = merge_snapshots({"r0": a, "r1": b})
+    (s,) = merged["serve_request_latency_seconds"]["series"]
+    assert s["exemplars"]["0.1"]["value"] == 0.09  # max kept
+    assert len(conflicts) == 1
+    assert "dup-0" in conflicts[0] and "conflicting values" in conflicts[0]
+    # Same id with the SAME value (one request legitimately scraped off
+    # two surfaces) is not a conflict.
+    _, clean = merge_snapshots({"r0": a, "r1": _hist_child([0.05], "dup")})
+    assert clean == []
+
+
+# -- TailWatcher --------------------------------------------------------------
+
+
+def _spans(e2e, queue=None):
+    q = queue if queue is not None else e2e / 4
+    return telemetry.spans_from_marks([
+        ("submit", 0.0), ("queue_wait", q), ("batch_form", q + 0.001),
+        ("h2d_stage", q + 0.002), ("device_compute", e2e),
+    ])
+
+
+def test_tail_threshold_is_max_of_slo_and_factor_p99():
+    reg = telemetry.MetricsRegistry()
+    w = TailWatcher(
+        registry=reg, slo_threshold_s=0.5, factor=4.0, seed_s=0.01,
+        min_interval_s=0.0,
+    )
+    # Seeded p99 = 10ms → factor arm 40ms; the SLO floor (500ms) wins.
+    assert w.threshold() == 0.5
+    assert reg.get("tail_threshold_seconds").value() == 0.5
+    # Without an SLO, the factor arm stands alone.
+    w2 = TailWatcher(factor=4.0, seed_s=0.01, min_interval_s=0.0)
+    assert w2.threshold() == pytest.approx(0.04)
+    # A latency storm raises the rolling p99 — the bar adapts upward.
+    for _ in range(64):
+        w2.observe("t", 0.03, _spans(0.03))
+    assert w2.threshold() == pytest.approx(0.12, rel=0.01)
+
+
+def test_tail_capture_contents_schema_and_span_sum_invariant():
+    reg = telemetry.MetricsRegistry()
+    events = []
+    flight = telemetry.FlightRecorder(capacity=16)
+    w = TailWatcher(
+        registry=reg, factor=2.0, seed_s=0.01, min_interval_s=0.0,
+        flight=flight,
+    )
+
+    class _W:  # duck-typed JsonlWriter
+        enabled = True
+        write = staticmethod(events.append)
+
+    w._events = _W()
+    # Under threshold (2 x 10ms): not captured.
+    assert w.observe("fast", 0.015, _spans(0.015)) is None
+    ev = w.observe(
+        "slow-1", 0.2, _spans(0.2),
+        outcome="served", bucket=4, batch_size=3,
+        queue_depth_at_submit=7, dispatch_seq=42, pad_waste_ratio=0.25,
+        watchdog={"tripped": False}, attribution=None,
+    )
+    assert ev is not None
+    telemetry.validate_event(ev)  # already validated at build; idempotent
+    a = ev["attrs"]
+    assert a["trace_id"] == "slow-1"
+    assert a["queue_depth_at_submit"] == 7
+    assert a["dispatch_seq"] == 42
+    assert a["bucket"] == 4 and a["batch_size"] == 3
+    assert a["pad_waste_ratio"] == 0.25
+    assert a["watchdog"] == {"tripped": False}
+    assert set(a["phases"]) == {
+        "queue_wait", "batch_form", "h2d_stage", "device_compute"
+    }
+    # ISSUE acceptance: span-sum == e2e holds ON the captured sample.
+    assert sum(s["duration_s"] for s in a["spans"]) == pytest.approx(
+        a["e2e_latency_s"], abs=1e-12
+    )
+    # Fan-out: counter, ring, flight ring, event sink.
+    assert reg.get("tail_samples_total").value() == 1
+    assert w.tail() == [ev]
+    assert events == [ev]
+    assert any(
+        e.get("name") == "tail.sample" for e in flight.tail()
+    )
+    assert w.state()["captured"] == 1
+
+
+def test_tail_rate_limit_and_disabled_capacity():
+    t = [0.0]
+    w = TailWatcher(factor=1.0, seed_s=0.01, min_interval_s=1.0,
+                    clock=lambda: t[0])
+    assert w.observe("a", 5.0, _spans(5.0)) is not None
+    # Slower request inside the rate window: suppressed, counted.
+    assert w.observe("b", 50.0, _spans(50.0)) is None
+    assert w.suppressed == 1
+    t[0] = 1.5
+    assert w.observe("c", 50.0, _spans(50.0)) is not None
+    assert w.captured == 2
+    # capacity=0 disables capture entirely (the A/B-overhead arm).
+    off = TailWatcher(factor=1.0, seed_s=0.01, capacity=0)
+    assert off.observe("d", 99.0, _spans(99.0)) is None
+    assert not off.enabled and off.captured == 0
+
+
+def test_tail_slow_request_does_not_raise_its_own_bar():
+    """The threshold is evaluated BEFORE the completion enters the
+    rolling window: the very request that breaks the tail open must be
+    judged against the healthy history."""
+    w = TailWatcher(factor=2.0, seed_s=0.01, min_interval_s=0.0, window=4)
+    # One massive outlier: captured even though including it in the
+    # window first would have set the bar at 2 x itself.
+    assert w.observe("huge", 10.0, _spans(10.0)) is not None
+
+
+# -- latency alert evidence ---------------------------------------------------
+
+
+def test_latency_exemplars_top_k_value_ordered():
+    reg = telemetry.MetricsRegistry()
+    h = telemetry.declare(reg, "serve_request_latency_seconds")
+    for i, v in enumerate((0.004, 0.04, 0.4, 4.0)):
+        h.observe(v, exemplar=f"t-{i}")
+    top = latency_exemplars(reg, "serve_request_latency_seconds", k=2)
+    assert [e["trace_id"] for e in top] == ["t-3", "t-2"]
+    assert top[0]["value"] == 4.0
+    # Absent metric / exemplar-free series degrade to empty, not raise.
+    assert latency_exemplars(reg, "nope") == []
+    telemetry.declare(reg, "loadgen_request_latency_seconds").observe(0.1)
+    assert latency_exemplars(reg, "loadgen_request_latency_seconds") == []
+
+
+def test_latency_alert_transition_carries_exemplar_evidence():
+    """ISSUE satellite: a firing latency_* transition attaches the top-K
+    exemplar trace ids as `evidence` (the PR-9 breaker-evidence pattern)
+    — pages link straight to the requests that burned the budget."""
+    reg = telemetry.MetricsRegistry()
+    spans = telemetry.declare(reg, "serve_span_seconds")
+    lat = telemetry.declare(reg, "serve_request_latency_seconds")
+
+    def serve(n, queue_s, compute_s, tag):
+        for i in range(n):
+            spans.observe(queue_s, phase="queue_wait")
+            spans.observe(compute_s, phase="device_compute")
+            lat.observe(queue_s + compute_s, exemplar=f"{tag}-{i}")
+
+    cfg = telemetry.SLOConfig(
+        latency_threshold_s=0.025, latency_target=0.99, interval_s=1.0
+    )
+    ev = telemetry.SLOEvaluator(
+        registry=reg, objectives=cfg.objectives(), config=cfg,
+        clock=lambda: 0, start=False,
+    )
+    serve(200, 0.002, 0.008, "ok")      # healthy baseline
+    ev.evaluate_once(now=0.0)
+    serve(100, 0.050, 0.008, "slow")    # regression
+    ev.evaluate_once(now=30.0)
+    trans = [
+        t for t in ev.transitions
+        if t["attrs"]["alert"] == "latency_fast_burn"
+        and t["attrs"]["to"] == "firing"
+    ]
+    evidence = trans[-1]["attrs"]["evidence"]
+    assert 1 <= len(evidence["exemplar_trace_ids"]) <= 5
+    # The worst request in the registry leads the evidence list.
+    assert evidence["exemplar_trace_ids"][0].startswith("slow-")
+    assert evidence["exemplars"][0]["value"] == pytest.approx(0.058)
+    telemetry.validate_event(trans[-1])  # schema holds with evidence on
+
+
+# -- fleet straggler detection ------------------------------------------------
+
+
+def test_bucket_quantile_conservative():
+    assert bucket_quantile({"0.1": 99, "1": 100, "+Inf": 100}, 0.99) == 0.1
+    assert bucket_quantile({"0.1": 98, "1": 100, "+Inf": 100}, 0.99) == 1.0
+    # Quantile past the finite range: floored at the largest bound.
+    assert bucket_quantile({"0.1": 0, "1": 90, "+Inf": 100}, 0.99) == 1.0
+    assert bucket_quantile({"+Inf": 0}, 0.99) is None
+
+
+def test_replica_skew_scores_against_fleet_median():
+    healthy = [0.01] * 99 + [0.02]
+    slow = [0.01] * 50 + [0.4] * 50
+    children = {
+        "r0": _hist_child(healthy, "a", buckets=(0.025, 0.05, 0.5)),
+        "r1": _hist_child(healthy, "b", buckets=(0.025, 0.05, 0.5)),
+        "r2": _hist_child(slow, "c", buckets=(0.025, 0.05, 0.5)),
+    }
+    skew = replica_skew(children, min_count=20)
+    assert skew["p99"] == {"r0": 0.025, "r1": 0.025, "r2": 0.5}
+    assert skew["median_p99"] == 0.025  # the straggler can't drag it
+    assert skew["skew"]["r2"] == 20.0
+    assert skew["skew"]["r0"] == 1.0
+    # Under min_count → excluded; fewer than 2 scored → no skew at all.
+    children["r3"] = _hist_child([0.01] * 5, "d", buckets=(0.025, 0.05, 0.5))
+    assert "r3" in replica_skew(children, min_count=20)["excluded"]
+    only_one = {"r0": children["r0"], "r3": children["r3"]}
+    assert replica_skew(only_one, min_count=20)["skew"] == {}
+
+
+def test_aggregator_flags_straggler_and_pages_on_alertz():
+    """ISSUE tentpole drill (deterministic half): three live /snapshotz
+    endpoints, one with a fat tail — the aggregator's scrape publishes
+    fleet_replica_skew naming it and fires the replica_straggler
+    advisory page on /alertz, with a transition naming the replica. The
+    end-to-end chaos `delay` version runs in test_fleet.py."""
+    regs = {
+        "r0": _child_registry([0.01] * 40),
+        "r1": _child_registry([0.01] * 40),
+        "r2": _child_registry([0.01] * 20 + [0.4] * 20),
+    }
+    servers = {n: telemetry.MetricsServer(r, port=0) for n, r in regs.items()}
+    agg = FederatedAggregator(
+        replicas={
+            n: f"http://127.0.0.1:{s.port}" for n, s in servers.items()
+        },
+        straggler_factor=2.0, straggler_min_count=20,
+        clock=lambda: 0,
+    )
+    try:
+        agg.scrape_once(now=0.0)
+        skew = {
+            s["labels"]["replica"]: s["value"]
+            for s in agg.registry.get("fleet_replica_skew").snapshot_series()
+        }
+        assert skew["r2"] > 2.0 >= skew["r0"]
+        assert agg.registry.get("alert_active").value(
+            alert="replica_straggler", severity="page"
+        ) == 1.0
+        (t,) = agg.straggler_transitions
+        assert t["attrs"]["replica"] == "r2"
+        assert t["attrs"]["to"] == "firing"
+        assert t["attrs"]["fleet_median_p99_s"] is not None
+        telemetry.validate_event(t)
+        srv = agg.serve(port=0)
+        alertz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/alertz", timeout=10
+        ).read())
+        assert any(
+            a["name"] == "replica_straggler" and a["state"] == "firing"
+            for a in alertz["alerts"]
+        )
+        assert alertz["straggler"]["skew"]["r2"] > 2.0
+        # Replacing the straggler (the supervisor's move) resolves the
+        # page: the remaining replicas score ~1 against each other.
+        # (Scores are cumulative-histogram-based, so recovery by
+        # dilution alone is slow by design — an advisory page should
+        # clear when the operator acts, not flap on a lucky minute.)
+        agg.remove_replica("r2")
+        agg.scrape_once(now=1.0)
+        assert agg.registry.get("alert_active").value(
+            alert="replica_straggler", severity="page"
+        ) == 0.0
+        assert agg.straggler_transitions[-1]["attrs"]["to"] == "inactive"
+    finally:
+        agg.close()
+        for s in servers.values():
+            s.close()
+
+
+def _child_registry(latencies):
+    reg = telemetry.MetricsRegistry()
+    h = telemetry.declare(reg, "serve_request_latency_seconds")
+    for v in latencies:
+        h.observe(v)
+    return reg
+
+
+# -- full stack: a live engine under load captures real samples ---------------
+
+
+def test_full_stack_engine_captures_schema_valid_tail_samples(tmp_path):
+    """ISSUE satellite + acceptance: a REAL engine + load generator with
+    the tail watcher forced hot (sub-p99 factor, no rate limit, a
+    latency SLO low enough not to floor it away) writes schema-valid
+    tail.sample events into the JSONL log, every one carrying the full
+    forensics context, spans summing exactly to the captured e2e, and
+    an exemplar for the same trace id in the engine's own histogram;
+    /debugz serves the tail state live."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.utils import get_depth
+
+    size = 16
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=size // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+    tdir = str(tmp_path / "tele")
+    engine = ServingEngine(
+        cells, params, stats, example_shape=(size, size, 3), max_batch=4,
+        default_deadline_s=30.0, telemetry_dir=tdir, metrics_port=0,
+        slo=telemetry.SLOConfig(
+            availability=0.99, latency_threshold_s=0.001, interval_s=0.2,
+        ),
+        tail_factor=0.5, tail_min_interval_s=0.0,
+    )
+    engine.start()
+    try:
+        run_closed_loop(
+            engine, 32, concurrency=8, deadline_s=30.0, events=engine.events,
+        )
+        dbg = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{engine.metrics_port}/debugz", timeout=10
+        ).read())
+        assert dbg["tail"]["captured"] >= 1
+        assert dbg["tail"]["threshold_s"] > 0
+        assert dbg["tail"]["samples"], "debugz serves the sample ring"
+        scraped = urllib.request.urlopen(
+            f"http://127.0.0.1:{engine.metrics_port}/metrics", timeout=10
+        ).read().decode()
+        assert "tail_samples_total" in scraped
+        assert '# {trace_id="' in scraped  # exemplars on the wire
+    finally:
+        engine.stop()
+    events = telemetry.read_events(
+        os.path.join(tdir, [
+            f for f in os.listdir(tdir) if f.startswith("telemetry-")
+        ][0])
+    )
+    samples = [
+        e for e in events
+        if e["kind"] == "event" and e["name"] == "tail.sample"
+    ]
+    assert samples, "the hot watcher must capture on a real run"
+    served = {
+        e["trace_id"]: e for e in events
+        if e["kind"] == "span" and e["name"] == "serve.request"
+        and e["attrs"]["outcome"].startswith("served")
+    }
+    for s in samples:
+        a = s["attrs"]
+        # Forensics context present on every capture.
+        for key in ("trace_id", "e2e_latency_s", "threshold_s", "phases",
+                    "spans", "queue_depth_at_submit", "dispatch_seq",
+                    "bucket", "batch_size", "pad_waste_ratio", "pid"):
+            assert key in a, key
+        assert a["dispatch_seq"] >= 0
+        # ISSUE acceptance: span-sum == e2e ON the captured samples.
+        assert sum(
+            sp["duration_s"] for sp in a["spans"]
+        ) == pytest.approx(a["e2e_latency_s"], abs=1e-9)
+        # The captured id is a real served request in the same log.
+        assert a["trace_id"] in served
+    # The registry's latency histogram carries an exemplar for at least
+    # one captured id (the aggregate→instance link, on a live run).
+    h = engine.registry.get("serve_request_latency_seconds")
+    (series,) = h.snapshot_series()
+    exemplar_ids = {e["trace_id"] for e in series["exemplars"].values()}
+    assert exemplar_ids & set(served)
+
+
+# -- analyze tail CLI ---------------------------------------------------------
+
+
+def _requeued_trace_logs(tmp_path):
+    """Canned multi-process logs of ONE fleet-requeued slow request
+    (client → router → dead-replica attempt → survivor engine) next to a
+    population of fast requests, plus a tail.sample and a metrics event
+    carrying the exemplar — the full join surface."""
+    tid = "fleet-aaaa-bbbbcccc-7"
+    log = tmp_path / "telemetry-drill.jsonl"
+    events = []
+    # Fast population → phase baselines (p50s) to compare against.
+    for i in range(20):
+        events.append(telemetry.span_event(
+            "serve.request", f"fast-{i}",
+            telemetry.spans_from_marks([
+                ("submit", 1.0 + i), ("queue_wait", 1.002 + i),
+                ("batch_form", 1.0021 + i), ("h2d_stage", 1.0024 + i),
+                ("device_compute", 1.010 + i),
+            ]),
+            attrs={"pid": 33, "role": "engine", "outcome": "served",
+                   "e2e_latency_s": 0.010},
+            ts=100.0 + i,
+        ))
+    # The slow request's cross-process segments.
+    events += [
+        telemetry.span_event(
+            "client.request", tid,
+            telemetry.spans_from_marks(
+                [("issue", 50.0), ("client_submit", 50.001),
+                 ("client_wait", 50.9)]
+            ),
+            attrs={"pid": 11, "role": "client", "outcome": "served",
+                   "e2e_latency_s": 0.9}, ts=200.9,
+        ),
+        telemetry.span_event(
+            "router.dispatch", tid,
+            telemetry.spans_from_marks([("sent", 10.0), ("rpc_r1", 10.4)]),
+            attrs={"pid": 22, "role": "router", "replica": "r1",
+                   "attempt": 1, "outcome": "error"}, ts=200.4,
+        ),
+        telemetry.span_event(
+            "router.dispatch", tid,
+            telemetry.spans_from_marks([("sent", 10.45), ("rpc_r0", 10.85)]),
+            attrs={"pid": 22, "role": "router", "replica": "r0",
+                   "attempt": 2, "outcome": "ok"}, ts=200.85,
+        ),
+        telemetry.span_event(
+            "serve.request", tid,
+            telemetry.spans_from_marks([
+                ("submit", 5.0), ("queue_wait", 5.3), ("batch_form", 5.31),
+                ("h2d_stage", 5.32), ("device_compute", 5.4),
+            ]),
+            attrs={"pid": 33, "role": "engine", "outcome": "served",
+                   "e2e_latency_s": 0.4}, ts=200.8,
+        ),
+    ]
+    # tail.sample for the id (engine-side capture).
+    events.append({
+        "ts": 200.81, "kind": "event", "name": "tail.sample",
+        "attrs": {"trace_id": tid, "e2e_latency_s": 0.4,
+                  "threshold_s": 0.05, "queue_depth_at_submit": 9,
+                  "bucket": 4, "batch_size": 4, "dispatch_seq": 17,
+                  "pad_waste_ratio": 0.0, "pid": 33},
+    })
+    # Exemplar-carrying metrics event (the fleet histogram's p99 bucket).
+    reg = telemetry.MetricsRegistry()
+    telemetry.declare(reg, "fleet_request_latency_seconds").observe(
+        0.9, exemplar=tid
+    )
+    events.append(telemetry.metrics_event(reg, ts=201.0))
+    with open(log, "w") as f:
+        for e in events:
+            f.write(json.dumps(telemetry.validate_event(e)) + "\n")
+    return tid, str(log)
+
+
+def test_analyze_tail_trace_report_renders_requeued_lifetime(tmp_path, capsys):
+    """ISSUE tentpole acceptance: `analyze tail --trace-id` renders a
+    fleet-requeued slow request's client → router (dead attempt +
+    survivor attempt) → replica lifetime end to end, each phase against
+    the window p50, with the dominant phase named — through the real
+    analysis-CLI dispatch (pure JSON, pre-jax)."""
+    from mpi4dl_tpu.analysis.cli import main
+
+    tid, log = _requeued_trace_logs(tmp_path)
+    assert main(["tail", log, "--trace-id", tid, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["trace_id"] == tid
+    assert rep["e2e_s"] == pytest.approx(0.9)       # the client's view
+    assert rep["processes"] == [11, 22, 33]          # 3 processes joined
+    names = [s["name"] for s in rep["segments"]]
+    assert names.count("router.dispatch") == 2       # dead + survivor
+    assert {"client.request", "serve.request"} <= set(names)
+    assert rep["dominant_phase"] == "client_wait"
+    # The engine segment's queue_wait is compared against the fast
+    # population's p50 (2ms) — the slow request waited 150x longer.
+    engine_seg = [s for s in rep["segments"] if s["name"] == "serve.request"]
+    qw = [p for p in engine_seg[0]["phases"] if p["phase"] == "queue_wait"][0]
+    assert qw["vs_p50"] == pytest.approx(0.3 / 0.002, rel=0.01)
+    # tail.sample + exemplar joined under the same id.
+    assert rep["tail_samples"][0]["attrs"]["queue_depth_at_submit"] == 9
+    assert rep["exemplars"][0]["metric"] == "fleet_request_latency_seconds"
+    # Text mode renders without error and names the dominant phase.
+    assert main(["tail", log, "--trace-id", tid]) == 0
+    out = capsys.readouterr().out
+    assert "dominant phase: client_wait" in out
+    assert "rpc_r1" in out and "rpc_r0" in out      # both attempts visible
+
+
+def test_analyze_tail_top_table_and_exit_codes(tmp_path, capsys):
+    from mpi4dl_tpu.analysis.cli import main
+
+    tid, log = _requeued_trace_logs(tmp_path)
+    assert main(["tail", log, "--top", "3", "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert len(rows) == 3
+    assert rows[0]["trace_id"] == tid               # slowest first
+    assert rows[0]["tail_sampled"] and rows[0]["exemplar"]
+    assert rows[0]["e2e_s"] >= rows[1]["e2e_s"] >= rows[2]["e2e_s"]
+    assert main(["tail", log, "--list-exemplars"]) == 0
+    assert tid in capsys.readouterr().out
+    # Missing trace id / empty logs exit nonzero.
+    assert main(["tail", log, "--trace-id", "nope"]) == 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main(["tail", str(empty)]) == 1

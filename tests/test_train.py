@@ -179,13 +179,46 @@ def test_scanq_matches_golden():
 
 def test_scanq_store_budget_matches_golden(monkeypatch):
     """MPI4DL_TPU_SCANQ_STORE_MB grants runs the plain stored-carry scan
-    front-to-back until the budget runs out; the rest stay anchored — a
-    storage-placement choice only: numerics must equal the golden step.
-    A 1 MB budget covers depth-44's first stage run (~0.92 MB of f32
-    compact carries) and denies the later two, exercising BOTH paths in
-    one trace."""
+    BACK-TO-FRONT until the budget runs out (the late stages free their
+    carries before the early stages' backward runs — the safe grants);
+    the rest stay anchored — a storage-placement choice only: numerics
+    must equal the golden step. Re-pinned for ISSUE 10's grant-order fix
+    (was front-to-back, the opposite of the docstring's own rationale):
+    a 1 MB budget now covers depth-44's LATER stage runs (per-stage
+    compact-carry bytes roughly halve stage over stage) and denies the
+    ~0.92 MB first run, still exercising BOTH paths in one trace."""
     monkeypatch.setenv("MPI4DL_TPU_SCANQ_STORE_MB", "1")
     test_scan2_nested_remat_matches_golden(remat="scanq")
+
+
+def test_scanq_store_budget_grants_back_to_front(monkeypatch):
+    """ISSUE 10 satellite (ADVICE-r5): the store budget must go to the
+    LATEST fitting runs — they free their carries before the early runs'
+    backward executes — not be consumed front-to-back. Pure unit: a
+    stub plan of three equal-size eligible runs and a budget that covers
+    exactly two must grant the LAST TWO and deny the first. (The golden
+    tests can't pin this: grant order is numerics-neutral.)"""
+    import types
+
+    monkeypatch.setenv("MPI4DL_TPU_SCANQ_STORE_MB", "0.0024")  # 2400 B
+
+    ident = types.SimpleNamespace(apply=lambda p, h: h)
+    stub = types.SimpleNamespace(
+        _scan_plan=[[0, 1, 2], [3, 4, 5], [6, 7, 8]],
+        _scan_plan_key=("k",),
+        _at_join=lambda i, h: h,
+        cells={i: ident for i in range(9)},
+    )
+    x = jnp.zeros((100,), jnp.float32)  # 400 B carry; 1200 B per run
+    params = {i: {} for i in range(9)}
+    granted = {
+        run[0]: Trainer._scanq_store_granted(stub, run, params, x)
+        for run in stub._scan_plan
+    }
+    assert granted == {0: False, 3: True, 6: True}
+    # Grant bytes recorded for the remat-effectiveness rule, per run.
+    assert stub._scanq_grant_bytes == {3: 1200, 6: 1200}
+    assert stub._scanq_budget_left == pytest.approx(0.0)
 
 
 def test_scan2_offload_matches_golden(monkeypatch):
