@@ -453,7 +453,101 @@ def _measure_serving() -> dict:
         entry["lint_findings"] = [
             f for f in lint.findings if f["severity"] == "error"
         ]
+    # Scheduler A/B (docs/SERVING.md "Scheduling"): the continuous EDF
+    # scheduler vs the PR-2 FIFO windowed former, interleaved, under a
+    # fixed mixed tight/bulk class load on the same model/config — the
+    # per-arm tight-class p99 (and aggregate rps) land in the result
+    # line so bench-history trends the EDF tail claim round over round
+    # (growing tight p99 fails CI; BENCH_SCHED_AB=0 disables).
+    if os.environ.get("BENCH_SCHED_AB", "1") != "0":
+        entry["sched_ab"] = _measure_sched_ab(cells, params, stats)
     return entry
+
+
+def _measure_sched_ab(cells, params, stats) -> dict:
+    """Interleaved EDF-vs-FIFO A/B on the PR-2 serving config (32px
+    AmoebaNet, buckets (1, 32)) under a fixed 1:3 tight:bulk class mix —
+    tight requests carry a 10 s deadline, bulk 60 s, so EDF order lets
+    tight jump the bulk backlog while FIFO serves arrival order. Both
+    arms run the SAME deterministic mix (ClassMix is RNG-free); per-arm
+    per-trial p99s are reduced by median across trials."""
+    from mpi4dl_tpu.profiling import percentiles as _pct
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    size = 32
+    classes = "tight=250ms:99@10s,bulk=2.5s:99@60s"
+    mix = {"tight": (1.0, 10.0), "bulk": (3.0, 60.0)}
+    trials, requests = 3, 256
+    engines = {
+        arm: ServingEngine(
+            cells, params, stats, example_shape=(size, size, 3),
+            buckets=(1, 32), max_wait_s=0.003, max_queue=512,
+            default_deadline_s=30.0, slo_classes=classes, scheduler=arm,
+        )
+        for arm in ("edf", "fifo")
+    }
+    samples = {
+        arm: {"tight_p99": [], "bulk_p99": [], "rps": [], "misses": 0}
+        for arm in engines
+    }
+    try:
+        for eng in engines.values():
+            eng.start()
+        for _ in range(trials):
+            for arm, eng in engines.items():
+                rep = run_closed_loop(
+                    eng, requests, concurrency=64, deadline_s=30.0,
+                    class_mix=dict(mix),
+                )
+                by = rep["by_class"] or {}
+                for cls, key in (("tight", "tight_p99"),
+                                 ("bulk", "bulk_p99")):
+                    p99 = (by.get(cls) or {}).get("latency_s", {}).get("p99")
+                    if p99 is not None:
+                        samples[arm][key].append(p99)
+                samples[arm]["rps"].append(rep["throughput_rps"])
+                samples[arm]["misses"] += rep["deadline_misses"]
+    finally:
+        for eng in engines.values():
+            eng.stop()
+
+    def _median(vals):
+        return _pct(vals, (50,))["p50"] if vals else None
+
+    arms = {
+        arm: {
+            "tight_p99_ms": (
+                round(_median(s["tight_p99"]) * 1e3, 2)
+                if s["tight_p99"] else None
+            ),
+            "bulk_p99_ms": (
+                round(_median(s["bulk_p99"]) * 1e3, 2)
+                if s["bulk_p99"] else None
+            ),
+            "rps": round(_median(s["rps"]), 1) if s["rps"] else None,
+            "deadline_misses": s["misses"],
+        }
+        for arm, s in samples.items()
+    }
+    out = {
+        "classes": classes,
+        "mix": "tight:1:10s,bulk:3:60s",
+        "trials": trials,
+        "requests_per_trial": requests,
+        "arms": arms,
+    }
+    edf, fifo = arms["edf"], arms["fifo"]
+    if edf["tight_p99_ms"] and fifo["tight_p99_ms"]:
+        out["tight_p99_improved"] = edf["tight_p99_ms"] < fifo["tight_p99_ms"]
+        out["tight_p99_ratio"] = round(
+            edf["tight_p99_ms"] / fifo["tight_p99_ms"], 3
+        )
+    if edf["rps"] and fifo["rps"]:
+        out["rps_delta_pct"] = round(
+            (edf["rps"] - fifo["rps"]) / fifo["rps"] * 100.0, 2
+        )
+    return out
 
 
 def _measure_fleet() -> dict:

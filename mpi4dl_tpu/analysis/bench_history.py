@@ -116,6 +116,22 @@ def extract_series(result: dict) -> "dict[str, float]":
             tail.get("p99_p50_ratio"), (int, float)
         ):
             out[f"{name}.tail_p99_p50_ratio"] = float(tail["p99_p50_ratio"])
+        # Scheduler A/B (serving extra, sched_ab): per-arm tight-class
+        # p99 under the fixed mixed-class load — trended with the
+        # INVERTED sign (a growing tight-class p99 under the EDF arm is
+        # the regression the continuous scheduler exists to prevent) —
+        # plus per-arm aggregate throughput with the normal sign.
+        ab = entry.get("sched_ab")
+        if isinstance(ab, dict):
+            for arm, rec in (ab.get("arms") or {}).items():
+                if not isinstance(rec, dict):
+                    continue
+                p99 = rec.get("tight_p99_ms")
+                if isinstance(p99, (int, float)):
+                    out[f"{name}.sched_tight_p99_ms[{arm}]"] = float(p99)
+                rps = rec.get("rps")
+                if isinstance(rps, (int, float)):
+                    out[f"{name}.sched_rps[{arm}]"] = float(rps)
         # Overlap A/B extra (sp2x2_overlap): per-arm measured overlap
         # ratio (falling fails) and SP train-step time (growing fails).
         arms = entry.get("arms")
@@ -144,6 +160,7 @@ def lower_is_better(key: str) -> bool:
         or key.endswith(".recovery_s")
         or ".step_time_s" in key
         or key.endswith(".tail_p99_p50_ratio")
+        or ".sched_tight_p99_ms" in key
     )
 
 

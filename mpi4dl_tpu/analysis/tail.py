@@ -192,6 +192,17 @@ def worst_traces(events, n: int = 10) -> "list[dict]":
         dominant = max(
             slowest["spans"], key=lambda s: s["duration_s"]
         )
+        # The request's SLO class: whichever segment names one (the
+        # engine and router both stamp it) — a straggler row names the
+        # class as well as the phase.
+        slo_class = next(
+            (
+                ev.get("attrs", {}).get("slo_class")
+                for ev in segments
+                if ev.get("attrs", {}).get("slo_class")
+            ),
+            None,
+        )
         rows.append({
             "trace_id": tid,
             "e2e_s": e2e,
@@ -199,6 +210,7 @@ def worst_traces(events, n: int = 10) -> "list[dict]":
             "dominant_s": dominant["duration_s"],
             "segments": len(segments),
             "outcome": slowest.get("attrs", {}).get("outcome"),
+            "slo_class": slo_class,
             "tail_sampled": tid in samples,
             "exemplar": tid in exemplars,
         })
@@ -243,6 +255,7 @@ def _print_trace(rep: dict) -> None:
         a = ts["attrs"]
         print(
             "  tail.sample: "
+            f"slo_class={a.get('slo_class')} "
             f"threshold={_fmt_ms(a.get('threshold_s'))} "
             f"queue_depth_at_submit={a.get('queue_depth_at_submit')} "
             f"bucket={a.get('bucket')} batch_size={a.get('batch_size')} "
@@ -324,12 +337,13 @@ def main(argv=None) -> int:
         return 0
     print(
         f"{'e2e':>12} {'dominant phase':<16} {'dom time':>12} "
-        f"{'seg':>3} {'tail?':>5} {'exemplar?':>9}  trace_id"
+        f"{'class':<10} {'seg':>3} {'tail?':>5} {'exemplar?':>9}  trace_id"
     )
     for r in rows:
         print(
             f"{_fmt_ms(r['e2e_s']):>12} {r['dominant_phase']:<16} "
-            f"{_fmt_ms(r['dominant_s']):>12} {r['segments']:>3} "
+            f"{_fmt_ms(r['dominant_s']):>12} "
+            f"{(r['slo_class'] or '-'):<10} {r['segments']:>3} "
             f"{'yes' if r['tail_sampled'] else '-':>5} "
             f"{'yes' if r['exemplar'] else '-':>9}  {r['trace_id']}"
         )

@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="open loop: offered requests/sec")
     p.add_argument("--duration", type=float, default=5.0)
     p.add_argument("--deadline-ms", type=float, default=30000.0)
+    p.add_argument("--slo-classes", default=None, metavar="SPEC",
+                   help="named SLO classes (NAME=THRESHOLD[:TARGET_PCT]"
+                        "[@DEADLINE], comma-separated) configured on the "
+                        "router AND every worker engine, so slo_class "
+                        "propagates client -> router -> replica scheduler")
+    p.add_argument("--class-mix", default=None, metavar="MIX",
+                   help="loadgen class mix NAME:WEIGHT[:DEADLINE], "
+                        "comma-separated; report carries by_class")
     p.add_argument("--queue-full-retries", type=int, default=0)
     # observability
     p.add_argument("--metrics-port", type=int, default=None,
@@ -133,6 +141,8 @@ def _worker_args(args) -> "list[str]":
         out += ["--depth", str(args.depth)]
     if args.telemetry_dir:
         out += ["--telemetry-dir", args.telemetry_dir]
+    if args.slo_classes:
+        out += ["--slo-classes", args.slo_classes]
     return out
 
 
@@ -169,6 +179,7 @@ def main(argv=None) -> int:
         max_attempts=args.max_attempts,
         inflight_per_replica=args.inflight_per_replica,
         telemetry_dir=args.telemetry_dir,
+        slo_classes=args.slo_classes,
     )
     federation = None
     if not args.no_federation:
@@ -225,17 +236,22 @@ def main(argv=None) -> int:
 
         monkey = ChaosMonkey(parse_chaos_specs(args.chaos), sup)
         monkey.start()
+        mix_kw = {}
+        if args.class_mix:
+            from mpi4dl_tpu.serve.loadgen import ClassMix
+
+            mix_kw["class_mix"] = ClassMix.parse(args.class_mix)
         if args.mode == "closed":
             report["loadgen"] = run_closed_loop(
                 router, args.requests, concurrency=args.concurrency,
                 deadline_s=args.deadline_ms / 1e3, events=router.events,
-                queue_full_retries=args.queue_full_retries,
+                queue_full_retries=args.queue_full_retries, **mix_kw,
             )
         else:
             report["loadgen"] = run_open_loop(
                 router, rate_rps=args.rate, duration_s=args.duration,
                 deadline_s=args.deadline_ms / 1e3, events=router.events,
-                queue_full_retries=args.queue_full_retries,
+                queue_full_retries=args.queue_full_retries, **mix_kw,
             )
 
         # Post-load: the drill isn't over until every scheduled chaos op

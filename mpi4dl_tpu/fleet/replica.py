@@ -94,9 +94,12 @@ class ReplicaClient:
         trace_id: str,
         deadline_s: float,
         timeout_s: float,
+        slo_class: "str | None" = None,
     ) -> "tuple[np.ndarray, dict]":
         """One blocking predict RPC; returns ``(logits, payload)`` or
-        raises one of the typed errors above."""
+        raises one of the typed errors above. ``slo_class`` propagates
+        the router-side SLO class into the replica engine's scheduler
+        (the worker's engine must declare the same classes)."""
         payload = {
             "trace_id": trace_id,
             "deadline_s": float(deadline_s),
@@ -105,6 +108,8 @@ class ReplicaClient:
             "x_b64": base64.b64encode(np.ascontiguousarray(x).tobytes())
             .decode(),
         }
+        if slo_class is not None:
+            payload["slo_class"] = str(slo_class)
         try:
             out = self._post("/predict", payload, timeout_s)
         except urllib.error.HTTPError as e:

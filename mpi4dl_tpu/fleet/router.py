@@ -98,17 +98,19 @@ class _Record:
     in flight) are detectable no-ops."""
 
     __slots__ = (
-        "x", "submit_t", "deadline", "future", "trace_id", "lock",
-        "state", "epoch", "attempts", "history", "first_dispatch_t",
-        "last_error",
+        "x", "submit_t", "deadline", "future", "trace_id", "slo_class",
+        "lock", "state", "epoch", "attempts", "history",
+        "first_dispatch_t", "last_error",
     )
 
-    def __init__(self, x, submit_t, deadline, future, trace_id):
+    def __init__(self, x, submit_t, deadline, future, trace_id,
+                 slo_class=None):
         self.x = x
         self.submit_t = submit_t
         self.deadline = deadline
         self.future = future
         self.trace_id = trace_id
+        self.slo_class = slo_class
         self.lock = threading.Lock()
         self.state = "pending"
         self.epoch = 0
@@ -183,6 +185,19 @@ class Router:
     events / telemetry_dir: span-segment sink (``events`` wins; a
         shared :class:`telemetry.JsonlWriter` lets the in-process load
         generator's client segments land in the same file).
+    slo_classes: named SLO classes (spec string / SLOClass sequence /
+        None — :mod:`mpi4dl_tpu.serve.scheduler`). ``submit(slo_class=)``
+        validates against them and the class rides every replica RPC, so
+        the replica engine's EDF scheduler sees the caller's class. The
+        router also applies the SAME burn-rate shedding policy
+        (:class:`~mpi4dl_tpu.serve.ClassFeedback`) at its own admission
+        edge: when the router's registry carries per-class
+        ``slo_burn_rate`` gauges (a federated aggregator evaluating
+        fleet-wide SLOs publishes them) and the pending queue is past
+        ``shed_queue_ratio``, admissions for deprioritized classes are
+        rejected before they cross a process boundary.
+    shed_queue_ratio: router-queue occupancy at which class-aware
+        shedding engages.
     """
 
     def __init__(
@@ -200,7 +215,13 @@ class Router:
         dispatch_timeout_s: "float | None" = None,
         events=None,
         telemetry_dir: "str | None" = None,
+        slo_classes=None,
+        shed_queue_ratio: float = 0.5,
     ):
+        from mpi4dl_tpu.serve.scheduler import (
+            ClassFeedback,
+            normalize_classes,
+        )
         self.example_shape = tuple(int(d) for d in example_shape)
         self._np_dtype = np.dtype(dtype)
         self.registry = (
@@ -219,6 +240,28 @@ class Router:
         self._health_interval_s = float(health_interval_s)
         self._scrape_timeout_s = float(scrape_timeout_s)
         self._dispatch_timeout_s = dispatch_timeout_s
+        # SLO classes + the engine-identical shedding policy. Feedback
+        # needs >1 class with at least one objective AND burn gauges in
+        # THIS registry (a federated evaluator publishes them); absent
+        # either, states() answers "normal" for everyone and the router
+        # sheds nothing — evidence-only, like the engine scheduler.
+        self._classes = normalize_classes(slo_classes)
+        self._class_names = {c.name for c in self._classes}
+        self._default_class = (
+            self._classes[-1] if "default" not in self._class_names
+            else next(c for c in self._classes if c.name == "default")
+        )
+        self._shed_queue_ratio = float(shed_queue_ratio)
+        self._feedback = (
+            ClassFeedback(self.registry, self._classes)
+            if len(self._classes) > 1
+            and any(c.latency_threshold_s for c in self._classes)
+            else None
+        )
+        self._m_shed = (
+            telemetry.declare(self.registry, "serve_class_shed_total")
+            if self._feedback is not None else None
+        )
 
         self._m_requests = telemetry.declare(
             self.registry, "fleet_requests_total"
@@ -244,7 +287,7 @@ class Router:
         self._counts = {
             "submitted": 0, "served": 0, "failed": 0,
             "rejected_queue_full": 0, "rejected_deadline": 0,
-            "drained": 0, "requeued": 0,
+            "drained": 0, "requeued": 0, "shed": 0,
         }
         self._latencies: "list[float]" = []
         self._stopping = False
@@ -340,10 +383,15 @@ class Router:
         x,
         deadline_s: "float | None" = None,
         trace_id: "str | None" = None,
+        slo_class: "str | None" = None,
     ):
         """Admit one request; returns a ``Future``. Mirrors
         :meth:`ServingEngine.submit` (queue-full/deadline semantics,
-        trace-id propagation) so engine clients need no changes."""
+        trace-id propagation, ``slo_class``) so engine clients need no
+        changes. The class is validated against the router's configured
+        classes and rides every replica RPC; under queue pressure the
+        burn-rate feedback sheds deprioritized classes HERE, before
+        a doomed request crosses to a replica."""
         from concurrent.futures import Future
 
         from mpi4dl_tpu.serve.engine import QueueFullError
@@ -353,26 +401,61 @@ class Router:
             raise ValueError(
                 f"example shape {x.shape} != configured {self.example_shape}"
             )
+        if slo_class is None:
+            cls = self._default_class
+        elif str(slo_class) in self._class_names:
+            cls = next(
+                c for c in self._classes if c.name == str(slo_class)
+            )
+        else:
+            raise ValueError(
+                f"unknown SLO class {slo_class!r} (configured: "
+                f"{sorted(self._class_names)})"
+            )
         if self._stopping:
             raise RuntimeError("router is stopped")
         now = time.monotonic()
-        ddl = now + (
-            deadline_s if deadline_s is not None else self._default_deadline_s
-        )
+        if deadline_s is None:
+            deadline_s = (
+                cls.deadline_s if cls.deadline_s is not None
+                else self._default_deadline_s
+            )
+        ddl = now + deadline_s
         rec = _Record(
             x=x, submit_t=now, deadline=ddl, future=Future(),
             trace_id=(
                 str(trace_id) if trace_id else telemetry.new_trace_id("fleet")
             ),
+            slo_class=cls.name,
         )
         with self._cond:
-            if len(self._pending) >= self._max_queue:
+            depth = len(self._pending)
+            if (
+                self._feedback is not None
+                and depth >= self._shed_queue_ratio * self._max_queue
+                and self._feedback.states().get(cls.name) == "deprioritized"
+            ):
+                # The engine scheduler's shed policy, applied one hop
+                # earlier: this class is burning budget slowest while
+                # another class burns hot, and the router queue is
+                # under pressure — reject instead of forwarding.
+                with self._lock:
+                    self._counts["rejected_queue_full"] += 1
+                    self._counts["shed"] += 1
+                self._m_requests.inc(outcome="rejected_queue_full")
+                self._m_shed.inc(slo_class=cls.name)
+                raise QueueFullError(
+                    f"router shed class {cls.name!r} by burn-rate "
+                    f"feedback ({depth}/{self._max_queue} waiting)",
+                    retry_after_s=0.05, slo_class=cls.name, shed=True,
+                )
+            if depth >= self._max_queue:
                 with self._lock:
                     self._counts["rejected_queue_full"] += 1
                 self._m_requests.inc(outcome="rejected_queue_full")
                 raise QueueFullError(
                     f"router queue full ({self._max_queue} waiting)",
-                    retry_after_s=0.05,
+                    retry_after_s=0.05, slo_class=cls.name,
                 )
             self._pending.append(rec)
             self._cond.notify()
@@ -510,6 +593,7 @@ class Router:
         try:
             logits, payload = rep.client.predict(
                 rec.x, rec.trace_id, deadline_s=remaining, timeout_s=timeout,
+                slo_class=rec.slo_class,
             )
         except ReplicaQueueFull as e:
             outcome, error = "queue_full", e
@@ -691,6 +775,7 @@ class Router:
                 "pid": os.getpid(), "role": "router", "outcome": outcome,
                 "attempts": len(rec.history), "replicas": rec.history,
                 "e2e_latency_s": end - rec.submit_t,
+                "slo_class": rec.slo_class,
             },
         ))
 

@@ -81,6 +81,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tail-min-interval", type=float, default=1.0,
                    help="rate limit between captured tail.samples, "
                         "seconds")
+    p.add_argument("--slo-classes", default=None, metavar="SPEC",
+                   help="named SLO classes for the engine scheduler "
+                        "(NAME=THRESHOLD[:TARGET_PCT][@DEADLINE], comma-"
+                        "separated) — must match the router's classes "
+                        "for slo_class propagation")
+    p.add_argument("--scheduler", choices=("edf", "fifo"), default="edf",
+                   help="engine batch former (edf = continuous "
+                        "scheduler; fifo = windowed baseline)")
     return p
 
 
@@ -195,11 +203,14 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                     x,
                     deadline_s=req.get("deadline_s"),
                     trace_id=req.get("trace_id"),
+                    slo_class=req.get("slo_class"),
                 )
             except QueueFullError as e:
                 self._reply(429, {
                     "ok": False, "error": "queue_full",
                     "retry_after_s": e.retry_after_s,
+                    "slo_class": e.slo_class,
+                    "shed": e.shed,
                 })
                 return
             try:
@@ -283,6 +294,8 @@ def main(argv=None) -> int:
         watchdog_min_timeout_s=args.watchdog_min_timeout,
         tail_factor=args.tail_factor,
         tail_min_interval_s=args.tail_min_interval,
+        slo_classes=args.slo_classes,
+        scheduler=args.scheduler,
     )
 
     chaos = _ChaosState()
@@ -304,7 +317,7 @@ def main(argv=None) -> int:
         if chaos.blackhole_healthz:
             time.sleep(3600)  # the probe black-hole drill
         snap = dict(engine.health.snapshot())
-        snap["queue_depth"] = engine._q.qsize()
+        snap["queue_depth"] = engine.queue_depth()
         snap["draining"] = draining.is_set()
         snap["pid"] = os.getpid()
         return snap

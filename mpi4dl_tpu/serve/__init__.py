@@ -37,6 +37,12 @@ from mpi4dl_tpu.serve.batching import (  # noqa: F401
     pad_batch,
     power_of_two_buckets,
 )
+from mpi4dl_tpu.serve.scheduler import (  # noqa: F401
+    ClassFeedback,
+    ClassScheduler,
+    SLOClass,
+    parse_slo_classes,
+)
 from mpi4dl_tpu.serve.engine import (  # noqa: F401
     DeadlineExceededError,
     DrainedError,

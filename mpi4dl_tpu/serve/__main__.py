@@ -44,9 +44,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-wait-ms", type=float, default=2.0,
                    help="batch formation window")
     p.add_argument("--max-queue", type=int, default=64,
-                   help="admission-control queue bound")
+                   help="admission-control queue bound (per SLO class)")
     p.add_argument("--deadline-ms", type=float, default=10000.0,
                    help="per-request deadline")
+    p.add_argument("--scheduler", choices=("edf", "fifo"), default="edf",
+                   help="batch former: edf = continuous scheduler "
+                        "(deadline-ordered class queues, in-flight "
+                        "re-admission, burn-rate feedback); fifo = the "
+                        "windowed max-wait/max-size former (the A/B "
+                        "baseline)")
+    p.add_argument("--slo-classes", default=None, metavar="SPEC",
+                   help="named SLO classes partitioning the queue, "
+                        "NAME=THRESHOLD[:TARGET_PCT][@DEADLINE] comma-"
+                        "separated (e.g. 'tight=50ms:99.9@200ms,"
+                        "bulk=2s'); each threshold becomes a per-class "
+                        "latency objective whose burn rate feeds the "
+                        "scheduler")
+    p.add_argument("--class-mix", default=None, metavar="MIX",
+                   help="loadgen traffic mix over the declared classes, "
+                        "NAME:WEIGHT[:DEADLINE] comma-separated (e.g. "
+                        "'tight:1:10s,bulk:3:60s'); the report then "
+                        "carries per-class latency under by_class")
     p.add_argument("--mode", choices=("closed", "open"), default="closed")
     p.add_argument("--requests", type=int, default=64,
                    help="closed loop: total requests")
@@ -176,6 +194,8 @@ def _synthetic_engine(args):
 
 def _liveness_kw(args) -> dict:
     return {
+        "slo_classes": args.slo_classes,
+        "scheduler": args.scheduler,
         "watchdog_factor": args.watchdog_factor or None,
         "watchdog_min_timeout_s": args.watchdog_min_timeout,
         "flight_capacity": args.flight_capacity,
@@ -291,6 +311,10 @@ def main(argv=None) -> int:
                     if args.retry_backoff_ms is not None else None
                 ),
             }
+            if args.class_mix:
+                from mpi4dl_tpu.serve.loadgen import ClassMix
+
+                retry_kw["class_mix"] = ClassMix.parse(args.class_mix)
             if args.mode == "closed":
                 report["loadgen"] = run_closed_loop(
                     engine, args.requests, concurrency=args.concurrency,

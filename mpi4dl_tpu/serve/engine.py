@@ -10,16 +10,36 @@ movement with compute, and never let the hot loop pay a compile):
   recompile — the no-surprise-JIT guarantee is an object-capability fact,
   not a convention, and :meth:`ServingEngine.assert_warm` checks every
   bucket has its executable before the loop starts.
-- **Admission control.** The request queue is bounded; a full queue rejects
-  at ``submit`` (:class:`QueueFullError`) instead of building unbounded
-  latency. Per-request deadlines are enforced twice: requests already
-  expired at batch-formation time are rejected without being served, and a
-  result that lands past its deadline is delivered as
-  :class:`DeadlineExceededError`, never silently late.
-- **Batch formation.** The batcher pops the first waiting request, then
-  collects up to ``max_batch`` requests or ``max_wait_s`` seconds —
-  whichever ends first — and right-pads into the smallest power-of-two
-  bucket (:mod:`mpi4dl_tpu.serve.batching`).
+- **Admission control.** The request queue is bounded per SLO class; a
+  full class queue rejects at ``submit`` (:class:`QueueFullError`, with
+  the class and a per-class retry hint) instead of building unbounded
+  latency. Per-request deadlines are enforced three times: a deadline
+  already expired at ``submit`` is rejected before it occupies a queue
+  slot, requests expired at batch-formation time are rejected without
+  being served, and a result that lands past its deadline is delivered
+  as :class:`DeadlineExceededError`, never silently late.
+- **Continuous batching + SLO-class EDF scheduling**
+  (:mod:`mpi4dl_tpu.serve.scheduler`). The queue is partitioned by named
+  SLO classes (``slo_classes=`` / ``submit(slo_class=)``), each class a
+  latency :class:`~mpi4dl_tpu.telemetry.slo.Objective` over
+  ``serve_class_latency_seconds{slo_class=}``; the batch former pops in
+  earliest-deadline-first order across classes the moment the device can
+  accept work — a new arrival joins the next dispatch instead of waiting
+  out a window, and a tight-deadline request jumps bulk traffic by
+  construction. The per-class ``slo_burn_rate`` gauges feed back into
+  the scheduler: while a class burns its budget hot, classes burning
+  slowest are deprioritized and shed early. ``scheduler="fifo"`` keeps
+  the PR-2 windowed former (pop first, collect up to ``max_batch`` or
+  ``max_wait_s``) as the measured A/B baseline. Either way the batch is
+  right-padded into the smallest power-of-two bucket
+  (:mod:`mpi4dl_tpu.serve.batching`).
+- **Split/re-join.** A multi-image submission — ``(n, *example_shape)``,
+  any ``n`` — is split into per-image requests at admission (atomically:
+  all admitted or none) and re-joined in order into one ``(n, classes)``
+  result, so a request larger than the largest compiled bucket is the
+  engine's problem, not the caller's. Rows ride the same class queue
+  with one shared deadline and trace id, and are bit-identical to the
+  per-bucket forwards they split into.
 - **Double-buffered staging.** The loop stages batch *k+1* host→device
   (``jax.device_put``) and dispatches its executable — both asynchronous —
   *before* blocking on batch *k*'s results, so the next batch's transfer
@@ -67,7 +87,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import queue
 import shutil
 import tempfile
 import threading
@@ -80,6 +99,12 @@ import numpy as np
 from mpi4dl_tpu import telemetry
 from mpi4dl_tpu.profiling import annotate_step, percentiles
 from mpi4dl_tpu.serve.batching import bucket_for, pad_batch, power_of_two_buckets
+from mpi4dl_tpu.serve.scheduler import (
+    ClassFeedback,
+    ClassScheduler,
+    SchedulerFull,
+    normalize_classes,
+)
 
 
 class QueueFullError(RuntimeError):
@@ -87,14 +112,22 @@ class QueueFullError(RuntimeError):
 
     retry_after_s: advisory backoff hint derived from the live batch
         cadence (one batch drains up to ``max_batch`` queue slots per
-        period, so a slot frees within roughly one period) — a client
-        that waits this long before retrying lands when room plausibly
-        exists instead of hammering a full queue. None when the engine
-        has no cadence estimate yet (nothing served)."""
+        period, so a slot frees within roughly one period), scaled by
+        the rejected class's own backlog — a client that waits this
+        long before retrying lands when room plausibly exists instead
+        of hammering a full queue. None when the engine has no cadence
+        estimate yet (nothing served).
+    slo_class: the class whose queue rejected the admission (None from
+        publishers without classes, e.g. the pre-class router bound).
+    shed: True when the rejection was an early burn-rate-feedback shed
+        (the class was deprioritized), not a physically full queue."""
 
-    def __init__(self, msg: str, retry_after_s: "float | None" = None):
+    def __init__(self, msg: str, retry_after_s: "float | None" = None,
+                 slo_class: "str | None" = None, shed: bool = False):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
+        self.slo_class = slo_class
+        self.shed = shed
 
 
 class DeadlineExceededError(TimeoutError):
@@ -116,6 +149,7 @@ class _Request:
     deadline: float
     future: Future
     trace_id: str = ""
+    slo_class: str = "default"
     # Span boundaries (time.monotonic), filled in as the request moves:
     # picked by the batch former / batch complete / staged+dispatched.
     form_t: float = 0.0
@@ -127,6 +161,47 @@ class _Request:
     # slow request, not just how slow it was.
     queue_depth_at_submit: int = 0
     dispatch_seq: int = -1
+    # Split/re-join: the shared join a multi-image submission's rows
+    # resolve into, and this row's index in it.
+    join: "_Join | None" = None
+    row: int = 0
+
+
+class _Join:
+    """Re-join of one split multi-image submission: collects per-row
+    logits in submission order and resolves the caller's single Future
+    once every row lands — or fails it with the FIRST row failure
+    (deadline/crash), after which late rows are no-ops."""
+
+    def __init__(self, n: int, future: Future, trace_id: str,
+                 submit_t: float):
+        self.future = future
+        self.trace_id = trace_id
+        self.submit_t = submit_t
+        self._rows: "list" = [None] * n
+        self._remaining = n
+        self._failed = False
+        self._lock = threading.Lock()
+
+    def row_done(self, row: int, logits, now: float) -> None:
+        with self._lock:
+            if self._failed:
+                return
+            self._rows[row] = logits
+            self._remaining -= 1
+            done = self._remaining == 0
+        if done:
+            self.future.trace_id = self.trace_id
+            self.future.e2e_latency_s = now - self.submit_t
+            self.future.set_result(np.stack(self._rows))
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._failed:
+                return
+            self._failed = True
+        self.future.trace_id = self.trace_id
+        self.future.set_exception(exc)
 
 
 class ServingEngine:
@@ -209,6 +284,24 @@ class ServingEngine:
         state, latest attribution) into the JSONL log, the flight
         ring, and a ``tail_capacity``-bounded ring on ``/debugz``.
         ``tail_capacity=0`` disables capture (the A/B-overhead arm).
+    slo_classes: named SLO classes partitioning the admission queue
+        (:mod:`mpi4dl_tpu.serve.scheduler`): a spec string
+        (``"tight=50ms:99.9@200ms,bulk=2s"``), a sequence of
+        :class:`~mpi4dl_tpu.serve.SLOClass`, or None for the implicit
+        single ``default`` class. Each class with a threshold becomes a
+        latency objective over ``serve_class_latency_seconds`` — the
+        SLO evaluator runs whenever any class declares one, even
+        without ``slo=`` — and its published burn rate steers the
+        scheduler's deprioritize/shed feedback. Unclassed submissions
+        land in the class named ``default`` when present, else the
+        LAST configured class.
+    scheduler: ``"edf"`` (default) — the continuous scheduler:
+        deadline-ordered dispatch across class queues, in-flight
+        re-admission (no formation window), burn-rate feedback.
+        ``"fifo"`` — the PR-2 max-wait/max-size windowed former,
+        retained as the measured A/B baseline (bench.py ``sched_ab``).
+    shed_ratio: fraction of a class's queue bound at which a
+        DEPRIORITIZED class starts shedding admissions early.
     """
 
     def __init__(
@@ -239,6 +332,9 @@ class ServingEngine:
         tail_factor: float = 4.0,
         tail_min_interval_s: float = 1.0,
         tail_capacity: int = 64,
+        slo_classes=None,
+        scheduler: str = "edf",
+        shed_ratio: float = 0.5,
     ):
         import jax
         import jax.numpy as jnp
@@ -256,6 +352,11 @@ class ServingEngine:
         )
         self._max_wait_s = float(max_wait_s)
         self._default_deadline_s = float(default_deadline_s)
+        self._classes = normalize_classes(slo_classes)
+        self._class_objectives = [
+            o for o in (c.objective() for c in self._classes)
+            if o is not None
+        ]
         self._device = jax.devices()[0]
         # Params/stats live on the device once; per-request traffic is the
         # input batch only.
@@ -347,7 +448,20 @@ class ServingEngine:
             self.warm_latency_s[b] = time.perf_counter() - t0
         self.assert_warm()
 
-        self._q: queue.Queue[_Request] = queue.Queue(maxsize=max_queue)
+        # The continuous scheduler (or the fifo baseline): per-class
+        # bounded EDF queues + the batch former. Burn-rate feedback only
+        # exists when there is more than one class AND at least one
+        # class declares an objective — otherwise there is nothing to
+        # protect and nothing to read.
+        feedback = (
+            ClassFeedback(self.registry, self._classes)
+            if len(self._classes) > 1 and self._class_objectives
+            else None
+        )
+        self._sched = ClassScheduler(
+            self._classes, max_queue=max_queue, registry=self.registry,
+            mode=scheduler, feedback=feedback, shed_ratio=shed_ratio,
+        )
         self._poll_s = 0.02
         self._stop_evt = threading.Event()
         self._thread: threading.Thread | None = None
@@ -378,15 +492,18 @@ class ServingEngine:
         decl = lambda name: telemetry.declare(self.registry, name)  # noqa: E731
         self._m_submitted = decl("serve_submitted_total")
         self._m_requests = decl("serve_requests_total")
-        self._m_qdepth = decl("serve_queue_depth")
         self._m_batches = decl("serve_batches_total")
         self._m_occupancy = decl("serve_batch_occupancy")
         self._m_pad_waste = decl("serve_pad_waste_ratio")
         self._m_latency = decl("serve_request_latency_seconds")
+        # Per-class e2e latency: the series the per-class latency
+        # objectives (and the scheduler's burn feedback) read. The
+        # queue-depth gauges (total + per-class) are owned by the
+        # scheduler, which already declared them above.
+        self._m_class_latency = decl("serve_class_latency_seconds")
         self._m_spans = decl("serve_span_seconds")
         self._m_phase_share = decl("serve_phase_share")
         self._phase_totals: dict[str, float] = {}
-        self._m_qdepth.set(0)
         self._attr_every = int(attribution_every or 0)
         self._attr_min_interval_s = float(attribution_min_interval_s)
         self._attr_last_t = float("-inf")
@@ -417,14 +534,18 @@ class ServingEngine:
 
         # -- slow-request capture (telemetry/tail.py) -----------------------
         # Seeded with the AOT warm latency (like the watchdog) and
-        # floored at the SLO latency threshold when one is declared:
-        # under an objective, "slow" never means less than the objective.
+        # floored at the TIGHTEST declared latency threshold (the slo=
+        # config's or any SLO class's): under an objective, "slow" never
+        # means less than the strictest objective.
+        _thresholds = [
+            c.latency_threshold_s for c in self._classes
+            if c.latency_threshold_s is not None
+        ]
+        if slo is not None and getattr(slo, "latency_threshold_s", None):
+            _thresholds.append(slo.latency_threshold_s)
         self.tail = telemetry.TailWatcher(
             registry=self.registry,
-            slo_threshold_s=(
-                getattr(slo, "latency_threshold_s", None)
-                if slo is not None else None
-            ),
+            slo_threshold_s=min(_thresholds) if _thresholds else None,
             factor=tail_factor,
             seed_s=max(self.warm_latency_s.values()),
             min_interval_s=tail_min_interval_s,
@@ -446,22 +567,29 @@ class ServingEngine:
             self._attr_last_t = time.monotonic()
 
         # -- SLO evaluation (telemetry/slo.py, alerts.py, autoscale.py) -----
+        # Per-class latency objectives are appended to the configured
+        # ones, and the evaluator runs whenever ANY objective exists —
+        # including classes declared without an slo= config, because the
+        # scheduler's burn-rate feedback reads the evaluator's gauges.
         self.slo: "telemetry.SLOEvaluator | None" = None
-        if slo is not None:
-            objectives = slo.objectives()
+        slo_cfg = slo
+        if slo_cfg is None and self._class_objectives:
+            slo_cfg = telemetry.SLOConfig()
+        if slo_cfg is not None:
+            objectives = slo_cfg.objectives() + self._class_objectives
             # The evaluator also runs for a headroom-only config (no
             # availability/latency objective): the memory_headroom_low
             # alert rides the same tick.
-            if objectives or getattr(slo, "headroom_alert_ratio", None) is not None:
+            if objectives or getattr(slo_cfg, "headroom_alert_ratio", None) is not None:
                 autoscaler = telemetry.Autoscaler(
                     registry=self.registry,
-                    config=slo.autoscale,
+                    config=slo_cfg.autoscale,
                     queue_capacity=max_queue,
                 )
                 self.slo = telemetry.SLOEvaluator(
                     registry=self.registry,
                     objectives=objectives,
-                    config=slo,
+                    config=slo_cfg,
                     autoscaler=autoscaler,
                     events=self._events,
                     flight=self.flight,
@@ -507,6 +635,16 @@ class ServingEngine:
     @property
     def buckets(self) -> tuple[int, ...]:
         return self._buckets
+
+    @property
+    def slo_classes(self):
+        """The normalized :class:`~mpi4dl_tpu.serve.SLOClass` tuple."""
+        return self._classes
+
+    def queue_depth(self) -> int:
+        """Total requests waiting across every class queue (the
+        enriched-/healthz payload the fleet router scrapes)."""
+        return self._sched.qsize()
 
     @property
     def events(self) -> "telemetry.JsonlWriter":
@@ -593,11 +731,23 @@ class ServingEngine:
         x,
         deadline_s: float | None = None,
         trace_id: "str | None" = None,
+        slo_class: "str | None" = None,
     ) -> Future:
-        """Enqueue one example; returns a ``Future`` resolving to its
-        logits. Raises :class:`QueueFullError` when admission control
-        rejects; the future raises :class:`DeadlineExceededError` when the
-        deadline passes before delivery.
+        """Enqueue one example — or a multi-image batch of shape
+        ``(n, *example_shape)``, which is split into per-image requests
+        at admission and re-joined in order into one ``(n, classes)``
+        result. Returns a ``Future`` resolving to the logits. Raises
+        :class:`QueueFullError` when admission control rejects (the
+        class queue is full, or the burn-rate feedback shed it); the
+        future raises :class:`DeadlineExceededError` when the deadline
+        passes before delivery — including a deadline already expired
+        at submit, which is rejected before occupying any queue slot.
+
+        slo_class: the named SLO class this request belongs to
+        (``slo_classes=`` at construction). None lands in the default
+        class. The class decides EDF queueing, the default deadline,
+        and which per-class latency objective the request's outcome
+        burns.
 
         trace_id: distributed-trace propagation — a caller in ANOTHER
         process (load generator, fleet router) passes the id it minted so
@@ -608,59 +758,99 @@ class ServingEngine:
         attributes, so the caller can compute its own hop overhead
         (``serve_client_overhead_seconds``)."""
         x = np.asarray(x, self._np_dtype)
-        if x.shape != self.example_shape:
+        multi = (
+            x.ndim == len(self.example_shape) + 1
+            and x.shape[0] >= 1
+            and tuple(x.shape[1:]) == self.example_shape
+        )
+        if not multi and x.shape != self.example_shape:
             raise ValueError(
                 f"example shape {x.shape} != configured {self.example_shape}"
+                f" (or (n, *{self.example_shape}) for a multi-image request)"
             )
+        cls = self._sched.resolve(slo_class)
         if self._stop_evt.is_set() and self._thread is None:
             raise RuntimeError("engine is stopped; call start() first")
         now = time.monotonic()
-        ddl = now + (
-            deadline_s if deadline_s is not None else self._default_deadline_s
-        )
-        req = _Request(
-            x=x, submit_t=now, deadline=ddl, future=Future(),
-            trace_id=(
-                str(trace_id) if trace_id else telemetry.new_trace_id("serve")
-            ),
-        )
+        if deadline_s is None:
+            deadline_s = (
+                cls.deadline_s if cls.deadline_s is not None
+                else self._default_deadline_s
+            )
+        ddl = now + deadline_s
+        tid = str(trace_id) if trace_id else telemetry.new_trace_id("serve")
+        rows = list(x) if multi else [x]
+        n = len(rows)
+        future: Future = Future()
+        if ddl <= now:
+            # Admission-time deadline check: an already-expired deadline
+            # is rejected with the existing typed error before it ever
+            # occupies a queue slot (per-row counted, like formation-
+            # time rejection).
+            with self._lock:
+                self._counts["rejected_deadline"] += n
+            self._m_requests.inc(n, outcome="rejected_deadline")
+            future.trace_id = tid
+            future.set_exception(DeadlineExceededError(
+                "deadline already expired at submit — rejected at admission"
+            ))
+            return future
+        join = _Join(n, future, tid, submit_t=now) if multi else None
+        reqs = [
+            _Request(
+                x=row, submit_t=now, deadline=ddl,
+                future=future if join is None else Future(),
+                trace_id=tid, slo_class=cls.name, join=join, row=i,
+            )
+            for i, row in enumerate(rows)
+        ]
         with self._lock:
-            self._counts["submitted"] += 1
-        self._m_submitted.inc()
+            self._counts["submitted"] += n
+        self._m_submitted.inc(n)
         # Arm the watchdog BEFORE the enqueue: if the loop has already
         # stalled, the very request that exposes it must be counted as
         # outstanding. A queue-full reject cancels (not "done" — an
         # admission bounce is not loop progress and must not reset the
         # stall clock).
         if self.watchdog is not None:
-            self.watchdog.begin()
+            for _ in reqs:
+                self.watchdog.begin()
         try:
-            self._q.put_nowait(req)
-        except queue.Full:
+            # Atomic: a multi-image split admits all rows or none.
+            depth = self._sched.put_many(reqs)
+        except SchedulerFull as e:
             if self.watchdog is not None:
-                self.watchdog.cancel()
+                for _ in reqs:
+                    self.watchdog.cancel()
             with self._lock:
-                self._counts["rejected_queue_full"] += 1
-            self._m_requests.inc(outcome="rejected_queue_full")
+                self._counts["rejected_queue_full"] += n
+            self._m_requests.inc(n, outcome="rejected_queue_full")
             raise QueueFullError(
-                f"request queue full ({self._q.maxsize} waiting)",
-                retry_after_s=self.retry_after_hint(),
+                str(e),
+                retry_after_s=self.retry_after_hint(e.slo_class),
+                slo_class=e.slo_class, shed=e.shed,
             ) from None
-        depth = self._q.qsize()
-        req.queue_depth_at_submit = depth
-        self._m_qdepth.set(depth)
-        return req.future
+        for r in reqs:
+            r.queue_depth_at_submit = depth
+        return future
 
-    def retry_after_hint(self) -> float:
+    def retry_after_hint(self, slo_class: "str | None" = None) -> float:
         """How long a queue-full-rejected client should wait before
         retrying: one batch-completion period (EMA), floored at the
         batch-formation window. Before the first completed batch the
-        warm latency stands in — the engine's only cadence fact."""
+        warm latency stands in — the engine's only cadence fact. With a
+        class name, the hint scales by that class's own backlog (its
+        queued requests drain at most ``max_batch`` per batch, so a
+        deep class queue frees a slot proportionally later)."""
         with self._lock:
             ema = self._batch_period_ema
         if ema is None:
             ema = max(self.warm_latency_s.values())
-        return max(self._max_wait_s, ema)
+        hint = max(self._max_wait_s, ema)
+        if slo_class is not None:
+            depth = self._sched.qsize_by_class().get(slo_class, 0)
+            hint *= max(1.0, min(10.0, depth / self._max_batch))
+        return hint
 
     def predict_one(self, x) -> np.ndarray:
         """Synchronous batch-size-1 forward through the bucket-1
@@ -685,7 +875,9 @@ class ServingEngine:
         out["latency_s"] = percentiles(lat)
         if out["batches"]:
             out["mean_batch_size"] = out["batched_examples"] / out["batches"]
-        out["queue_depth"] = self._q.qsize()
+        out["queue_depth"] = self._sched.qsize()
+        out["queue_depth_by_class"] = self._sched.qsize_by_class()
+        out["scheduler"] = self._sched.state()
         out["pad_waste_ratio"] = padded / total if total else 0.0
         out["buckets"] = list(self._buckets)
         out["warm_latency_s"] = dict(self.warm_latency_s)
@@ -816,7 +1008,7 @@ class ServingEngine:
     def _loop_inner(self) -> None:
         inflight = None
         while True:
-            reqs = self._form_batch()
+            reqs = self._form_batch(busy=inflight is not None)
             staged = None
             if reqs:
                 try:
@@ -841,7 +1033,7 @@ class ServingEngine:
                             flight=self.flight, dump=True,
                         )
                     for r in reqs:
-                        r.future.set_exception(e)
+                        self._fail_request(r, e)
                         if self.watchdog is not None:
                             self.watchdog.done()
             if inflight is not None:
@@ -850,38 +1042,38 @@ class ServingEngine:
             if (
                 inflight is None
                 and self._stop_evt.is_set()
-                and self._q.empty()
+                and self._sched.empty()
             ):
                 return
 
-    def _form_batch(self) -> "list[_Request] | None":
-        try:
-            req = self._q.get(timeout=self._poll_s)
-        except queue.Empty:
+    def _form_batch(self, busy: bool = False) -> "list[_Request] | None":
+        """One scheduler take. The continuous (edf) former never makes
+        an IDLE device wait out a window — with nothing in flight, the
+        first arrival dispatches with whatever else is already queued.
+        But while a batch IS in flight (``busy``), the device cannot
+        accept work anyway, so the former keeps the ``max_wait_s``
+        collection window open to fill the next batch — arrivals during
+        the in-flight compute join the next dispatch, and occupancy
+        matches the windowed former under load. Fifo mode always holds
+        the window (the PR-2 baseline). Requests whose deadline passed
+        while queued come back in ``expired`` and are rejected without
+        occupying a batch slot."""
+        reqs, expired = self._sched.take(
+            self._max_batch,
+            first_timeout_s=self._poll_s,
+            window_s=(
+                self._max_wait_s
+                if (self._sched.mode == "fifo" or busy) else 0.0
+            ),
+        )
+        for r in expired:
+            self._reject_deadline(r)
+        if not reqs:
             return None
-        reqs: list[_Request] = []
-        window_end = time.monotonic() + self._max_wait_s
-        while True:
-            req.form_t = time.monotonic()  # queue_wait ends at the pop
-            if req.form_t > req.deadline:
-                self._reject_deadline(req)
-            else:
-                reqs.append(req)
-            if len(reqs) >= self._max_batch:
-                break
-            timeout = window_end - time.monotonic()
-            if timeout <= 0:
-                break
-            try:
-                req = self._q.get(timeout=timeout)
-            except queue.Empty:
-                break
-        self._m_qdepth.set(self._q.qsize())
-        if reqs:
-            formed = time.monotonic()
-            for r in reqs:
-                r.formed_t = formed
-        return reqs or None
+        formed = time.monotonic()
+        for r in reqs:
+            r.formed_t = formed
+        return reqs
 
     def _dispatch(self, reqs: "list[_Request]"):
         import jax
@@ -1008,15 +1200,17 @@ class ServingEngine:
                 self.watchdog.done(now - r.submit_t)
             # Cross-process trace surface: the caller (loadgen today, the
             # fleet router tomorrow) reads these off the future to compute
-            # its hop overhead and to join its own span segment.
-            r.future.trace_id = r.trace_id
-            r.future.e2e_latency_s = now - r.submit_t
+            # its hop overhead and to join its own span segment. Join
+            # rows set them on the OUTER future at re-join instead.
+            if r.join is None:
+                r.future.trace_id = r.trace_id
+                r.future.e2e_latency_s = now - r.submit_t
             if now > r.deadline:
                 with self._lock:
                     self._counts["served_late"] += 1
                 self._m_requests.inc(outcome="served_late")
                 self._emit_spans(r, now, "served_late", bucket, len(reqs))
-                r.future.set_exception(DeadlineExceededError(
+                self._fail_request(r, DeadlineExceededError(
                     f"result ready {now - r.deadline:.3f}s past deadline — "
                     "dropped rather than silently served late"
                 ))
@@ -1026,8 +1220,15 @@ class ServingEngine:
                 self._latencies.append(now - r.submit_t)
             self._m_requests.inc(outcome="served")
             self._m_latency.observe(now - r.submit_t, exemplar=r.trace_id)
+            self._m_class_latency.observe(
+                now - r.submit_t, exemplar=r.trace_id,
+                slo_class=r.slo_class,
+            )
             self._emit_spans(r, now, "served", bucket, len(reqs))
-            r.future.set_result(logits[i])
+            if r.join is not None:
+                r.join.row_done(r.row, logits[i], now)
+            else:
+                r.future.set_result(logits[i])
         self._publish_phase_shares()
 
     def _emit_spans(
@@ -1064,6 +1265,7 @@ class ServingEngine:
             self.tail.observe(
                 r.trace_id, end_t - r.submit_t, spans,
                 outcome=outcome, bucket=bucket, batch_size=batch_size,
+                slo_class=r.slo_class,
                 queue_depth_at_submit=r.queue_depth_at_submit,
                 dispatch_seq=r.dispatch_seq,
                 pad_waste_ratio=padded / total if total else 0.0,
@@ -1079,6 +1281,7 @@ class ServingEngine:
                 attrs={"outcome": outcome, "bucket": bucket,
                        "batch_size": batch_size,
                        "e2e_latency_s": end_t - r.submit_t,
+                       "slo_class": r.slo_class,
                        "pid": os.getpid(), "role": "engine"},
             )
             self.flight.record(ev)
@@ -1100,14 +1303,24 @@ class ServingEngine:
             ev = telemetry.span_event(
                 "serve.request", req.trace_id, spans,
                 attrs={"outcome": "rejected_deadline",
+                       "slo_class": req.slo_class,
                        "pid": os.getpid(), "role": "engine"},
             )
             self.flight.record(ev)
             if self._events.enabled:
                 self._events.write(ev)
-        req.future.set_exception(DeadlineExceededError(
+        self._fail_request(req, DeadlineExceededError(
             "deadline expired while the request waited for batch formation"
         ))
+
+    def _fail_request(self, req: _Request, exc: BaseException) -> None:
+        """Deliver a failure: directly onto a single request's future,
+        or into a multi-image request's join (first failure wins the
+        whole join; later rows are no-ops)."""
+        if req.join is not None:
+            req.join.fail(exc)
+        else:
+            req.future.set_exception(exc)
 
     def _flush_queue(self, msg: str, outcome: "str | None" = "drained") -> None:
         """Fail every still-queued request. ``outcome="drained"``
@@ -1117,17 +1330,13 @@ class ServingEngine:
         budget. ``outcome=None`` (batcher crash) keeps the bare
         RuntimeError: those ARE failures and the crash already
         surfaced through health/flight."""
-        while True:
-            try:
-                req = self._q.get_nowait()
-            except queue.Empty:
-                return
+        for req in self._sched.drain():
             if self.watchdog is not None:
                 self.watchdog.cancel()
             if outcome == "drained":
                 with self._lock:
                     self._counts["drained"] += 1
                 self._m_requests.inc(outcome="drained")
-                req.future.set_exception(DrainedError(msg))
+                self._fail_request(req, DrainedError(msg))
             else:
-                req.future.set_exception(RuntimeError(msg))
+                self._fail_request(req, RuntimeError(msg))

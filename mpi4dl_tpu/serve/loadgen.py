@@ -53,6 +53,68 @@ from mpi4dl_tpu.serve.engine import (
 )
 
 
+class ClassMix:
+    """Deterministic class-mix traffic: smooth weighted round-robin over
+    named SLO classes, so a ``{"tight": 1, "bulk": 3}`` mix emits
+    ``bulk, tight, bulk, bulk, ...`` identically on every run (no RNG —
+    A/B arms must see the SAME arrival pattern).
+
+    mix: ``{name: weight}`` or ``{name: (weight, deadline_s)}`` — a
+    per-class deadline overrides the run's global ``deadline_s`` for
+    that class's requests (None defers to the engine's class default).
+    """
+
+    def __init__(self, mix: dict):
+        self._entries = []
+        for name, spec in mix.items():
+            if isinstance(spec, (tuple, list)):
+                weight, deadline_s = spec
+            else:
+                weight, deadline_s = spec, None
+            weight = float(weight)
+            if weight <= 0:
+                raise ValueError(f"class {name}: weight must be > 0")
+            self._entries.append({
+                "name": str(name), "weight": weight,
+                "deadline_s": deadline_s, "current": 0.0,
+            })
+        if not self._entries:
+            raise ValueError("empty class mix")
+        self._total = sum(e["weight"] for e in self._entries)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "ClassMix":
+        """``"tight:1:250ms,bulk:3"`` → ClassMix
+        (``NAME:WEIGHT[:DEADLINE]``)."""
+        from mpi4dl_tpu.serve.scheduler import parse_duration_s
+
+        mix = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            toks = part.split(":")
+            if len(toks) not in (2, 3):
+                raise ValueError(
+                    f"bad mix entry {part!r}: expected NAME:WEIGHT[:DEADLINE]"
+                )
+            mix[toks[0]] = (
+                float(toks[1]),
+                parse_duration_s(toks[2]) if len(toks) == 3 else None,
+            )
+        return cls(mix)
+
+    def next(self) -> "tuple[str, float | None]":
+        """The next request's ``(slo_class, deadline_s_override)``."""
+        with self._lock:
+            for e in self._entries:
+                e["current"] += e["weight"]
+            best = max(self._entries, key=lambda e: e["current"])
+            best["current"] -= self._total
+            return best["name"], best["deadline_s"]
+
+
 def _default_example(engine: ServingEngine):
     rng = np.random.default_rng(0)
 
@@ -97,6 +159,9 @@ class _Tally:
         self.queue_full_retries = 0
         self.deadline_misses = 0
         self.errors = 0
+        # Per-SLO-class outcome/latency split (class-mix runs): the
+        # per-class p99 the EDF-vs-FIFO A/B is judged by.
+        self.by_class: "dict[str, dict]" = {}
         self._events = events
         self._m_requests = self._m_latency = self._m_overhead = None
         if registry is not None:
@@ -116,9 +181,23 @@ class _Tally:
         if self._m_requests is not None:
             self._m_requests.inc(outcome=outcome)
 
-    def reject(self) -> None:
+    def _cls(self, slo_class: "str | None") -> "dict | None":
+        if slo_class is None:
+            return None
+        rec = self.by_class.get(slo_class)
+        if rec is None:
+            rec = self.by_class[slo_class] = {
+                "latencies": [], "served": 0, "deadline_misses": 0,
+                "errors": 0, "rejected_queue_full": 0,
+            }
+        return rec
+
+    def reject(self, slo_class: "str | None" = None) -> None:
         with self.lock:
             self.rejected_queue_full += 1
+            rec = self._cls(slo_class)
+            if rec is not None:
+                rec["rejected_queue_full"] += 1
         self._count("rejected_queue_full")
 
     def retried(self) -> None:
@@ -133,6 +212,7 @@ class _Tally:
         t_submit: float,
         trace_id: "str | None" = None,
         t_submitted: "float | None" = None,
+        slo_class: "str | None" = None,
     ) -> None:
         outcome = "served"
         try:
@@ -141,10 +221,16 @@ class _Tally:
             outcome = "deadline_miss"
             with self.lock:
                 self.deadline_misses += 1
+                rec = self._cls(slo_class)
+                if rec is not None:
+                    rec["deadline_misses"] += 1
         except Exception:  # noqa: BLE001 — tallied, surfaced in the report
             outcome = "error"
             with self.lock:
                 self.errors += 1
+                rec = self._cls(slo_class)
+                if rec is not None:
+                    rec["errors"] += 1
         t_done = time.monotonic()
         self._count(outcome)
         engine_e2e = getattr(future, "e2e_latency_s", None)
@@ -154,6 +240,10 @@ class _Tally:
             with self.lock:
                 self.served += 1
                 self.latencies.append(lat)
+                rec = self._cls(slo_class)
+                if rec is not None:
+                    rec["served"] += 1
+                    rec["latencies"].append(lat)
             if self._m_latency is not None:
                 self._m_latency.observe(lat)
             if engine_e2e is not None:
@@ -197,6 +287,7 @@ class _Tally:
 def _submit_with_retry(
     engine, x, deadline_s, tid, tally: _Tally,
     queue_full_retries: int, retry_backoff_s: "float | None",
+    slo_class: "str | None" = None,
 ):
     """Submit with opt-in bounded retry on queue-full. Each bounce waits
     the engine's ``retry_after_s`` cadence hint (or the explicit
@@ -206,12 +297,13 @@ def _submit_with_retry(
     the future, or None when the bounces exhausted the budget (tallied
     as a terminal rejection)."""
     attempts = 0
+    kw = {"slo_class": slo_class} if slo_class is not None else {}
     while True:
         try:
-            return engine.submit(x, deadline_s=deadline_s, trace_id=tid)
+            return engine.submit(x, deadline_s=deadline_s, trace_id=tid, **kw)
         except QueueFullError as e:
             if attempts >= queue_full_retries:
-                tally.reject()
+                tally.reject(slo_class)
                 return None
             base = (
                 retry_backoff_s if retry_backoff_s is not None
@@ -232,6 +324,7 @@ def run_closed_loop(
     events=None,
     queue_full_retries: int = 0,
     retry_backoff_s: "float | None" = None,
+    class_mix: "ClassMix | dict | None" = None,
 ) -> dict:
     """``concurrency`` clients ping-ponging until ``num_requests`` total
     have been submitted. High concurrency >> max batch keeps the queue
@@ -241,10 +334,15 @@ def run_closed_loop(
     endpoint; ``events`` (a JsonlWriter, e.g. ``engine.events``) adds a
     ``client.request`` span segment per request to the trace log.
     ``queue_full_retries`` (opt-in) bounds per-request backoff-retries on
-    admission bounces, honoring ``QueueFullError.retry_after_s``."""
+    admission bounces, honoring ``QueueFullError.retry_after_s``.
+    ``class_mix`` (a :class:`ClassMix` or its dict form) tags each
+    request with a deterministically-rotated SLO class (and optional
+    per-class deadline); the report then carries ``by_class``."""
     from mpi4dl_tpu import telemetry
 
     make_example = make_example or _default_example(engine)
+    if class_mix is not None and not isinstance(class_mix, ClassMix):
+        class_mix = ClassMix(class_mix)
     tally = _Tally(
         registry if registry is not None else engine.registry, events=events,
     )
@@ -257,15 +355,23 @@ def run_closed_loop(
                 i = next(ticket, None)
             if i is None:
                 return
+            cls, cls_deadline = (
+                class_mix.next() if class_mix is not None else (None, None)
+            )
             tid = telemetry.new_trace_id("client")
             t = time.monotonic()
             fut = _submit_with_retry(
-                engine, make_example(i), deadline_s, tid, tally,
-                queue_full_retries, retry_backoff_s,
+                engine, make_example(i),
+                cls_deadline if cls_deadline is not None else deadline_s,
+                tid, tally, queue_full_retries, retry_backoff_s,
+                slo_class=cls,
             )
             if fut is None:
                 continue
-            tally.resolve(fut, t, trace_id=tid, t_submitted=time.monotonic())
+            tally.resolve(
+                fut, t, trace_id=tid, t_submitted=time.monotonic(),
+                slo_class=cls,
+            )
 
     threads = [threading.Thread(target=client) for _ in range(concurrency)]
     t0 = time.perf_counter()
@@ -288,16 +394,21 @@ def run_open_loop(
     events=None,
     queue_full_retries: int = 0,
     retry_backoff_s: "float | None" = None,
+    class_mix: "ClassMix | dict | None" = None,
 ) -> dict:
     """Fixed-rate arrivals for ``duration_s`` seconds; completions are
     collected by worker threads so a slow tail never throttles arrivals.
     With ``queue_full_retries`` > 0, admission bounces retry with
     backoff INSIDE the per-request worker thread — the arrival clock
     stays open-loop (arrivals never wait on a retry), which is exactly
-    the overload regime where shed-and-retry behavior is measured."""
+    the overload regime where shed-and-retry behavior is measured.
+    ``class_mix`` tags arrivals with rotated SLO classes (see
+    :func:`run_closed_loop`)."""
     from mpi4dl_tpu import telemetry
 
     make_example = make_example or _default_example(engine)
+    if class_mix is not None and not isinstance(class_mix, ClassMix):
+        class_mix = ClassMix(class_mix)
     tally = _Tally(
         registry if registry is not None else engine.registry, events=events,
     )
@@ -307,40 +418,54 @@ def run_open_loop(
     t0 = time.perf_counter()
     start = time.monotonic()
 
-    def submit_and_resolve(x, tid, t):
+    def submit_and_resolve(x, tid, t, cls, cls_deadline):
         fut = _submit_with_retry(
-            engine, x, deadline_s, tid, tally,
-            queue_full_retries, retry_backoff_s,
+            engine, x,
+            cls_deadline if cls_deadline is not None else deadline_s,
+            tid, tally, queue_full_retries, retry_backoff_s, slo_class=cls,
         )
         if fut is not None:
-            tally.resolve(fut, t, trace_id=tid, t_submitted=time.monotonic())
+            tally.resolve(
+                fut, t, trace_id=tid, t_submitted=time.monotonic(),
+                slo_class=cls,
+            )
 
     while time.perf_counter() - t0 < duration_s:
         target = start + n * period
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
+        cls, cls_deadline = (
+            class_mix.next() if class_mix is not None else (None, None)
+        )
         tid = telemetry.new_trace_id("client")
         t = time.monotonic()
         n += 1
         if queue_full_retries > 0:
             # Retries sleep; they must do so off the arrival clock.
             w = threading.Thread(
-                target=submit_and_resolve, args=(make_example(n), tid, t),
+                target=submit_and_resolve,
+                args=(make_example(n), tid, t, cls, cls_deadline),
             )
             w.start()
             waiters.append(w)
             continue
         try:
             fut = engine.submit(
-                make_example(n), deadline_s=deadline_s, trace_id=tid
+                make_example(n),
+                deadline_s=(
+                    cls_deadline if cls_deadline is not None else deadline_s
+                ),
+                trace_id=tid,
+                **({"slo_class": cls} if cls is not None else {}),
             )
         except QueueFullError:
-            tally.reject()
+            tally.reject(cls)
             continue
         w = threading.Thread(
             target=tally.resolve, args=(fut, t),
-            kwargs={"trace_id": tid, "t_submitted": time.monotonic()},
+            kwargs={"trace_id": tid, "t_submitted": time.monotonic(),
+                    "slo_class": cls},
         )
         w.start()
         waiters.append(w)
@@ -373,6 +498,17 @@ def _report(mode, offered, dt, tally: _Tally, engine, **extra) -> dict:
         "client_overhead_s": (
             {**percentiles(ov), "mean": float(np.mean(ov))} if ov else None
         ),
+        # Class-mix runs: the per-class split the EDF A/B is judged by.
+        "by_class": {
+            name: {
+                "served": rec["served"],
+                "deadline_misses": rec["deadline_misses"],
+                "errors": rec["errors"],
+                "rejected_queue_full": rec["rejected_queue_full"],
+                "latency_s": percentiles(rec["latencies"]),
+            }
+            for name, rec in sorted(tally.by_class.items())
+        } or None,
         "engine": engine.stats(),
         **extra,
     }

@@ -70,6 +70,31 @@ CATALOG: "dict[str, MetricSpec]" = {
         "histogram", (),
         "End-to-end latency of served requests (submit -> result ready).",
     ),
+    "serve_class_latency_seconds": MetricSpec(
+        "histogram", ("slo_class",),
+        "End-to-end latency of served requests, by SLO class — the "
+        "per-class latency objectives (slo_burn_rate{slo=latency_<class>}"
+        ") the EDF scheduler's burn-rate feedback reads back.",
+    ),
+    "serve_class_queue_depth": MetricSpec(
+        "gauge", ("slo_class",),
+        "Requests waiting in each SLO class's EDF admission queue "
+        "(serve_queue_depth stays the cross-class total the autoscaler "
+        "consumes).",
+    ),
+    "serve_class_shed_total": MetricSpec(
+        "counter", ("slo_class",),
+        "Admissions shed early by the burn-rate feedback policy: the "
+        "class was deprioritized (burning budget slowest while another "
+        "class burned hot) and its queue was past the shed ratio. "
+        "Published by the engine scheduler and the fleet router alike.",
+    ),
+    "serve_class_deprioritized": MetricSpec(
+        "gauge", ("slo_class",),
+        "1 while the burn-rate feedback currently deprioritizes the "
+        "class (it fills batch slots only after protected classes and "
+        "sheds admissions early), else 0.",
+    ),
     "serve_span_seconds": MetricSpec(
         "histogram", ("phase",),
         "Per-request lifecycle span durations: queue_wait, batch_form, "

@@ -76,6 +76,12 @@ class Objective:
     # lifecycle event and must not burn the availability budget.
     ignore_outcomes: tuple = ()
     threshold_s: float = 0.0
+    # Series selector for latency objectives over a LABELED histogram:
+    # ((label, value), ...) pairs — the per-SLO-class objectives select
+    # their class's serve_class_latency_seconds{slo_class=} series with
+    # this. Empty = the metric's unlabeled series (the classic e2e
+    # objective).
+    labels: tuple = ()
 
     def __post_init__(self):
         if not 0.0 < self.target < 1.0:
@@ -109,12 +115,13 @@ def latency_objective(
     threshold_s: float,
     metric: str = "serve_request_latency_seconds",
     name: str = "latency",
+    labels: tuple = (),
 ) -> Objective:
     if threshold_s <= 0:
         raise ValueError(f"latency threshold must be > 0, got {threshold_s}")
     return Objective(
         name=name, kind="latency", target=target, metric=metric,
-        threshold_s=float(threshold_s),
+        threshold_s=float(threshold_s), labels=tuple(labels),
     )
 
 
@@ -146,7 +153,8 @@ def sli(window, objective: Objective, window_s: float) -> "float | None":
             ignore=objective.ignore_outcomes,
         )
     # latency
-    h = window.hist_increase(objective.metric, window_s)
+    sel = dict(objective.labels)
+    h = window.hist_increase(objective.metric, window_s, **sel)
     if not h or h["count"] <= 0:
         return None
     bounds = [float(le) for le in h["buckets"] if le != "+Inf"]
@@ -154,7 +162,7 @@ def sli(window, objective: Objective, window_s: float) -> "float | None":
     if bound is None:
         return 0.0
     return window.bucket_ratio(
-        objective.metric, window_s, bound,
+        objective.metric, window_s, bound, **sel,
     )
 
 
@@ -191,6 +199,15 @@ def cumulative_sli(registry, objective: Objective) -> "float | None":
             in objective.good_outcomes
         )
         return good / total
+    # Latency: restrict to the objective's label selector (a per-class
+    # objective reads only its class's series; an unlabeled objective
+    # sums every series of the metric).
+    sel = dict(objective.labels)
+    if sel:
+        series = [
+            s for s in series
+            if all(s["labels"].get(k) == v for k, v in sel.items())
+        ]
     total = sum(s["count"] for s in series)
     if total <= 0:
         return None

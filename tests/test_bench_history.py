@@ -150,6 +150,67 @@ def test_tail_ratio_trended_and_inverted(tmp_path):
     assert main(paths) == 0
 
 
+def test_sched_ab_series_trended_and_inverted(tmp_path):
+    """ISSUE 11 CI satellite: the serving extra's scheduler A/B embeds
+    per-arm tight-class p99 under the fixed mixed-class load; bench-
+    history trends it with the INVERTED sign (a growing tight-class p99
+    fails CI) and the per-arm aggregate rps with the normal sign."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def with_ab(edf_p99, fifo_p99=60.0, edf_rps=1700.0):
+        r = _result(7.0, 0.5)
+        r["extras"]["serving_amoebanet3_32px"] = {
+            "value": 2000.0,
+            "sched_ab": {
+                "classes": "tight=250ms:99@10s,bulk=2.5s:99@60s",
+                "arms": {
+                    "edf": {"tight_p99_ms": edf_p99, "bulk_p99_ms": 70.0,
+                            "rps": edf_rps, "deadline_misses": 0},
+                    "fifo": {"tight_p99_ms": fifo_p99, "bulk_p99_ms": 55.0,
+                             "rps": 1650.0, "deadline_misses": 0},
+                },
+                "tight_p99_improved": edf_p99 < fifo_p99,
+            },
+        }
+        return r
+
+    s = extract_series(with_ab(40.0))
+    assert s["serving_amoebanet3_32px.sched_tight_p99_ms[edf]"] == 40.0
+    assert s["serving_amoebanet3_32px.sched_tight_p99_ms[fifo]"] == 60.0
+    assert s["serving_amoebanet3_32px.sched_rps[edf]"] == 1700.0
+    assert lower_is_better(
+        "serving_amoebanet3_32px.sched_tight_p99_ms[edf]"
+    )
+    assert not lower_is_better("serving_amoebanet3_32px.sched_rps[edf]")
+
+    # Growing tight-class p99 on the EDF arm: CI-visible regression.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_ab(40.0)), _round(2, 0, with_ab(55.0)),
+    ])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(
+             paths, [with_ab(40.0), with_ab(55.0)]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key[
+        "serving_amoebanet3_32px.sched_tight_p99_ms[edf]"
+    ]["verdict"] == "regressed"
+    # Shrinking tight p99 is the improvement; a dropped EDF rps is the
+    # throughput regression (normal sign).
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_ab(55.0)), _round(2, 0, with_ab(40.0)),
+    ])
+    assert main(paths) == 0
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_ab(40.0, edf_rps=1700.0)),
+        _round(2, 0, with_ab(40.0, edf_rps=1400.0)),
+    ])
+    assert main(paths) == 1
+
+
 def test_peak_hbm_series_regresses_on_growth(tmp_path):
     """ISSUE satellite: memory series get the SAME verdict treatment as
     throughput — tolerance band, compare against the last round that

@@ -1,0 +1,575 @@
+"""Continuous batching + SLO-class EDF scheduling (ISSUE 11 tentpole):
+``mpi4dl_tpu/serve/scheduler.py`` core goldens (class spec parsing, EDF
+ordering across classes, fifo baseline order, starvation bound, burn-rate
+feedback deprioritize/shed), the engine integration (admission-time
+deadline rejection, per-class queue isolation + retry hints, multi-image
+split/re-join bit-identity, per-class metrics + burn gauges, tail.sample
+class tagging), the fleet propagation seam (worker RPC + router
+shedding), and the live A/B: under a mixed tight/bulk load, the tight
+class's p99 beats the FIFO windowed former (tier-1, CPU).
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.evaluate import collect_batch_stats
+from mpi4dl_tpu.models.resnet import get_resnet_v2
+from mpi4dl_tpu.parallel.partition import init_cells
+from mpi4dl_tpu.serve import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServingEngine,
+    SLOClass,
+    parse_slo_classes,
+)
+from mpi4dl_tpu.serve.scheduler import (
+    ClassFeedback,
+    ClassScheduler,
+    SchedulerFull,
+    normalize_classes,
+)
+from mpi4dl_tpu.utils import get_depth
+
+SIZE = 16
+
+
+@pytest.fixture(scope="module")
+def model():
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=SIZE // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, SIZE, SIZE, 3))
+    )
+    cal = [jnp.asarray(rng.standard_normal((4, SIZE, SIZE, 3)), jnp.float32)]
+    stats = collect_batch_stats(cells, params, cal)
+    return cells, params, stats
+
+
+def _engine(model, **kw):
+    cells, params, stats = model
+    kw.setdefault("example_shape", (SIZE, SIZE, 3))
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("default_deadline_s", 30.0)
+    return ServingEngine(cells, params, stats, **kw)
+
+
+def _examples(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+        for _ in range(n)
+    ]
+
+
+class _Req:
+    """Scheduler duck-type: deadline + slo_class, form_t stamped."""
+
+    def __init__(self, deadline, slo_class="default", tag=None):
+        self.deadline = deadline
+        self.slo_class = slo_class
+        self.tag = tag
+        self.form_t = 0.0
+
+
+# -- class spec + normalization ----------------------------------------------
+
+
+def test_parse_slo_classes_goldens():
+    tight, bulk = parse_slo_classes("tight=50ms:99.9@200ms,bulk=2s")
+    assert tight.name == "tight"
+    assert tight.latency_threshold_s == pytest.approx(0.05)
+    assert tight.target == pytest.approx(0.999)
+    assert tight.deadline_s == pytest.approx(0.2)
+    assert bulk.latency_threshold_s == pytest.approx(2.0)
+    assert bulk.target == pytest.approx(0.99)
+    assert bulk.deadline_s is None
+    # A class with no objective (pure scheduling bucket).
+    (free,) = parse_slo_classes("free=none@5s")
+    assert free.latency_threshold_s is None
+    assert free.objective() is None
+    # The objective: metric + labels select the class's series, the slo
+    # label value is what the scheduler's feedback reads back.
+    obj = tight.objective()
+    assert obj.metric == "serve_class_latency_seconds"
+    assert obj.labels == (("slo_class", "tight"),)
+    assert obj.name == "latency_tight"
+    with pytest.raises(ValueError, match="NAME=THRESHOLD"):
+        parse_slo_classes("tight")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_slo_classes("a=1s,a=2s")
+    with pytest.raises(ValueError, match="must match"):
+        parse_slo_classes("Bad-Name=1s")
+    # normalize: None -> the implicit default class.
+    (default,) = normalize_classes(None)
+    assert default.name == "default" and default.objective() is None
+
+
+def test_class_mix_rotation_is_deterministic():
+    from mpi4dl_tpu.serve.loadgen import ClassMix
+
+    mix = ClassMix({"tight": (1, 10.0), "bulk": (3, None)})
+    pattern = [mix.next()[0] for _ in range(8)]
+    mix2 = ClassMix.parse("tight:1:10s,bulk:3")
+    assert pattern == [mix2.next()[0] for _ in range(8)]
+    assert pattern.count("tight") == 2 and pattern.count("bulk") == 6
+    # Smooth: tight is spread out, not bursty.
+    assert pattern[0] == "bulk" or pattern[1] == "bulk"
+
+
+# -- scheduler core goldens ---------------------------------------------------
+
+
+def _sched(mode="edf", classes="tight=50ms,bulk=2s", cap=64, **kw):
+    return ClassScheduler(
+        normalize_classes(classes), max_queue=cap, mode=mode, **kw
+    )
+
+
+def test_edf_ordering_across_classes():
+    s = _sched()
+    now = time.monotonic()
+    # Bulk arrives FIRST but with later deadlines; EDF pops tights first,
+    # each class internally deadline-ordered.
+    for i, d in enumerate((100.0, 90.0, 110.0)):
+        s.put(_Req(now + d, "bulk", tag=f"b{i}"))
+    for i, d in enumerate((10.0, 5.0)):
+        s.put(_Req(now + d, "tight", tag=f"t{i}"))
+    reqs, expired = s.take(10, first_timeout_s=0.1)
+    assert not expired
+    assert [r.tag for r in reqs] == ["t1", "t0", "b1", "b0", "b2"]
+
+
+def test_fifo_mode_preserves_arrival_order():
+    s = _sched(mode="fifo")
+    now = time.monotonic()
+    s.put(_Req(now + 100.0, "bulk", tag="b0"))
+    s.put(_Req(now + 1.0, "tight", tag="t0"))
+    s.put(_Req(now + 50.0, "bulk", tag="b1"))
+    reqs, _ = s.take(10, first_timeout_s=0.1)
+    assert [r.tag for r in reqs] == ["b0", "t0", "b1"]
+
+
+def test_expired_requests_surface_separately():
+    s = _sched()
+    now = time.monotonic()
+    s.put(_Req(now - 1.0, "tight", tag="dead"))
+    s.put(_Req(now + 60.0, "tight", tag="live"))
+    reqs, expired = s.take(10, first_timeout_s=0.1)
+    assert [r.tag for r in reqs] == ["live"]
+    assert [r.tag for r in expired] == ["dead"]
+    assert expired[0].form_t > 0  # span boundary stamped for the reject
+
+
+def test_starvation_bound_bulk_deadline_advances_to_front():
+    """EDF's starvation bound IS the deadline: a queued bulk request
+    outranks every tight arrival whose deadline lands after it, so bulk
+    is served no later than its own deadline order — continuous tight
+    traffic cannot push it back indefinitely."""
+    s = _sched()
+    now = time.monotonic()
+    s.put(_Req(now + 5.0, "bulk", tag="bulk"))
+    # Tight stream: early arrivals beat bulk, later ones (deadline past
+    # bulk's) do not.
+    s.put(_Req(now + 1.0, "tight", tag="t-early"))
+    s.put(_Req(now + 9.0, "tight", tag="t-late"))
+    reqs, _ = s.take(10, first_timeout_s=0.1)
+    assert [r.tag for r in reqs] == ["t-early", "bulk", "t-late"]
+
+
+def test_per_class_bounds_and_atomic_group_admission():
+    s = _sched(cap=3)
+    now = time.monotonic()
+    for _ in range(3):
+        s.put(_Req(now + 60.0, "bulk"))
+    with pytest.raises(SchedulerFull) as ei:
+        s.put(_Req(now + 60.0, "bulk"))
+    assert ei.value.slo_class == "bulk" and not ei.value.shed
+    # Class isolation: bulk full, tight still admits.
+    s.put(_Req(now + 1.0, "tight"))
+    assert s.qsize_by_class() == {"tight": 1, "bulk": 3}
+    # Atomic group: a 3-row group over tight's remaining room (2 slots
+    # free) admits nothing at all.
+    group = [_Req(now + 2.0, "tight") for _ in range(3)]
+    with pytest.raises(SchedulerFull):
+        s.put_many(group)
+    assert s.qsize_by_class()["tight"] == 1
+
+
+def test_feedback_deprioritizes_and_sheds_slowest_burning_class():
+    reg = telemetry.MetricsRegistry()
+    burn = telemetry.declare(reg, "slo_burn_rate")
+    classes = normalize_classes("tight=50ms,bulk=2s")
+    fb = ClassFeedback(reg, classes, min_interval_s=0.0)
+    # No burn data: nobody is deprioritized (evidence-only policy).
+    assert fb.states() == {"tight": "normal", "bulk": "normal"}
+    # Tight burns hot, bulk burns cold -> bulk (the slowest burner)
+    # yields; the protected class never does.
+    burn.set(20.0, slo="latency_tight", window="fast_long")
+    burn.set(0.1, slo="latency_bulk", window="fast_long")
+    assert fb.states() == {"tight": "normal", "bulk": "deprioritized"}
+    # Both burning hot: nobody yields (can't rob Peter to pay Paul).
+    burn.set(20.0, slo="latency_bulk", window="fast_long")
+    assert fb.states() == {"tight": "normal", "bulk": "normal"}
+
+    # Scheduler honors the state: a deprioritized class goes LAST even
+    # with the earliest deadline, and sheds early at shed_ratio.
+    burn.set(0.1, slo="latency_bulk", window="fast_long")
+    s = ClassScheduler(
+        classes, max_queue=8, registry=reg, mode="edf",
+        feedback=fb, shed_ratio=0.5,
+    )
+    now = time.monotonic()
+    s.put(_Req(now + 1.0, "bulk", tag="b"))     # earliest deadline...
+    s.put(_Req(now + 50.0, "tight", tag="t"))
+    reqs, _ = s.take(10, first_timeout_s=0.1)
+    assert [r.tag for r in reqs] == ["t", "b"]  # ...still yields
+    # Shed: bulk's effective bound is shed_ratio * capacity = 4.
+    for _ in range(4):
+        s.put(_Req(now + 60.0, "bulk"))
+    with pytest.raises(SchedulerFull) as ei:
+        s.put(_Req(now + 60.0, "bulk"))
+    assert ei.value.shed and ei.value.slo_class == "bulk"
+    assert s.shed_counts["bulk"] == 1
+    assert reg.get("serve_class_shed_total").value(slo_class="bulk") == 1
+    # Tight (protected) admits to the full bound.
+    for _ in range(8):
+        s.put(_Req(now + 1.0, "tight"))
+    with pytest.raises(SchedulerFull) as ei:
+        s.put(_Req(now + 1.0, "tight"))
+    assert not ei.value.shed
+
+
+# -- engine integration -------------------------------------------------------
+
+
+def test_admission_time_deadline_rejection(model):
+    """ISSUE satellite: an already-expired deadline is rejected at
+    submit — the typed error on the future, the rejected_deadline
+    outcome counted, and NO queue slot ever occupied."""
+    eng = _engine(model)
+    fut = eng.submit(_examples(1)[0], deadline_s=-0.5)
+    with pytest.raises(DeadlineExceededError, match="admission"):
+        fut.result(timeout=1)
+    s = eng.stats()
+    assert s["rejected_deadline"] == 1
+    assert s["queue_depth"] == 0
+    assert eng.registry.get("serve_requests_total").value(
+        outcome="rejected_deadline"
+    ) == 1
+    eng.stop()
+
+
+def test_queue_full_carries_class_and_scaled_hint(model):
+    """ISSUE satellite: the queue-full error names the class whose queue
+    rejected, and the retry hint scales with THAT class's backlog."""
+    eng = _engine(
+        model, max_queue=2, slo_classes="tight=50ms@30s,bulk=2s@60s",
+    )
+    eng.submit(_examples(1)[0], slo_class="bulk")
+    eng.submit(_examples(1)[0], slo_class="bulk")
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(_examples(1)[0], slo_class="bulk")
+    assert ei.value.slo_class == "bulk" and not ei.value.shed
+    assert ei.value.retry_after_s is not None
+    # Per-class isolation: tight still admits, and ITS hint is smaller
+    # (empty backlog) than bulk's would be (full backlog).
+    eng.submit(_examples(1)[0], slo_class="tight")
+    assert eng.retry_after_hint("tight") <= eng.retry_after_hint("bulk")
+    # Unknown class is a loud config error, not a silent misfile.
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        eng.submit(_examples(1)[0], slo_class="nope")
+    # stats() reflects the per-class queues.
+    s = eng.stats()
+    assert s["queue_depth_by_class"] == {"tight": 1, "bulk": 2}
+    assert s["queue_depth"] == 3
+    eng.start()
+    eng.stop()
+
+
+def test_multi_image_split_rejoin_bit_identity(model):
+    """ISSUE tentpole: a 6-image submission against max_batch=4 splits
+    into a bucket-4 and a bucket-2 dispatch and re-joins in order —
+    each row BYTE-identical to the corresponding unsplit per-bucket
+    forward (padding inertness + per-sample independence make the
+    split provably invisible)."""
+    eng = _engine(model, max_batch=4)
+    x = np.stack(_examples(6))
+    fut = eng.submit(x)  # queued before start: deterministic 4+2 split
+    eng.start()
+    try:
+        got = fut.result(timeout=60)
+    finally:
+        eng.stop()
+    assert got.shape == (6, 10)
+    cells, params, stats = model
+    want_4 = np.asarray(eng._compiled[4](eng._params, eng._stats, x[:4]))
+    pad2 = np.zeros((2, SIZE, SIZE, 3), np.float32)
+    want_2 = np.asarray(eng._compiled[2](
+        eng._params, eng._stats, x[4:6]
+    ))
+    del pad2
+    np.testing.assert_array_equal(got[:4], want_4)
+    np.testing.assert_array_equal(got[4:6], want_2)
+    # The outer future carries the shared trace identity; every row
+    # counted as a served request.
+    assert fut.trace_id
+    assert fut.e2e_latency_s > 0
+    s = eng.stats()
+    assert s["served"] == 6 and s["submitted"] == 6
+    assert s["bucket_dispatches"][4] == 1
+    assert s["bucket_dispatches"][2] == 1
+
+
+def test_multi_image_admission_is_atomic(model):
+    eng = _engine(model, max_queue=4)
+    with pytest.raises(QueueFullError):
+        eng.submit(np.stack(_examples(6)))
+    s = eng.stats()
+    assert s["queue_depth"] == 0  # nothing half-admitted
+    assert s["rejected_queue_full"] == 6
+    eng.stop()
+
+
+def test_per_class_metrics_burn_gauges_and_tail_class(model, tmp_path):
+    """Mixed-class traffic populates serve_class_latency_seconds per
+    class, the evaluator publishes per-class burn gauges (the
+    scheduler's feedback signal), spans + tail.samples carry the
+    class, and the class objectives appear on the SLO surface."""
+    eng = _engine(
+        model,
+        slo_classes="tight=1ms:99@30s,bulk=2s:99@60s",
+        telemetry_dir=str(tmp_path),
+        tail_factor=0.0,          # trip line = the 1ms class threshold
+        tail_min_interval_s=0.0,  # no rate limit: every trip captures
+    )
+    eng.start()
+    try:
+        examples = _examples(8)
+        futs = [
+            eng.submit(x, slo_class=("tight" if i % 2 else "bulk"))
+            for i, x in enumerate(examples[:4])
+        ]
+        for f in futs:
+            f.result(timeout=60)
+        eng.slo.evaluate_once()
+        # Traffic BETWEEN snapshots: a windowed burn needs a nonzero
+        # histogram delta inside the window, not just pre-window totals.
+        futs = [
+            eng.submit(x, slo_class=("tight" if i % 2 else "bulk"))
+            for i, x in enumerate(examples[4:])
+        ]
+        for f in futs:
+            f.result(timeout=60)
+        time.sleep(0.05)
+        eng.slo.evaluate_once()
+    finally:
+        eng.stop()
+    hist = eng.registry.get("serve_class_latency_seconds")
+    by_class = {
+        s["labels"]["slo_class"]: s["count"] for s in hist.snapshot_series()
+    }
+    assert by_class == {"tight": 4, "bulk": 4}
+    # The burn gauges the feedback reads back (both classes, page
+    # window) exist after the evaluator ticked.
+    burn = eng.registry.get("slo_burn_rate")
+    slos = {
+        s["labels"]["slo"] for s in burn.snapshot_series()
+        if s["labels"]["window"] == "fast_long"
+    }
+    assert {"latency_tight", "latency_bulk"} <= slos
+    # Every request slower than the absurd 1ms threshold tail-sampled
+    # with its class named (ISSUE satellite).
+    samples = eng.tail.tail(50)
+    assert samples, "no tail.sample captured despite the 1ms trip line"
+    assert all("slo_class" in ev["attrs"] for ev in samples)
+    assert {ev["attrs"]["slo_class"] for ev in samples} <= {"tight", "bulk"}
+    # Span events carry the class end to end.
+    (log,) = tmp_path.iterdir()
+    served = [
+        e for e in telemetry.read_events(str(log))
+        if e["kind"] == "span" and e["name"] == "serve.request"
+    ]
+    assert len(served) == 8
+    assert {e["attrs"]["slo_class"] for e in served} == {"tight", "bulk"}
+
+
+def test_analyze_tail_names_the_class(model, tmp_path):
+    """ISSUE satellite: `analyze tail` rows carry slo_class from the
+    span segments, so a straggler page names the class."""
+    from mpi4dl_tpu.analysis.tail import trace_report, worst_traces
+
+    eng = _engine(
+        model, slo_classes="tight=1ms:99@30s,bulk=2s:99@60s",
+        telemetry_dir=str(tmp_path),
+    )
+    eng.start()
+    try:
+        fut = eng.submit(_examples(1)[0], slo_class="tight")
+        fut.result(timeout=60)
+    finally:
+        eng.stop()
+    (log,) = tmp_path.iterdir()
+    events = telemetry.read_events(str(log))
+    rows = worst_traces(events, 5)
+    assert rows and rows[0]["slo_class"] == "tight"
+    rep = trace_report(events, fut.trace_id)
+    assert any(
+        seg["attrs"].get("slo_class") == "tight" for seg in rep["segments"]
+    )
+
+
+# -- the A/B: tight-class p99 beats the FIFO former ---------------------------
+
+
+def _run_arm(model, scheduler):
+    """One arm of the structural A/B: 48 bulk requests pre-queued, then
+    8 tight requests behind them. Under FIFO the tights drain the full
+    bulk backlog first; under EDF they jump it. Completion ORDER (not
+    wall time) is the structural signal; latency follows from it."""
+    eng = _engine(
+        model, max_batch=4, max_queue=256,
+        slo_classes="tight=50ms:99@30s,bulk=2s:99@120s",
+        scheduler=scheduler,
+    )
+    done = []
+    lock = threading.Lock()
+
+    def watch(name, fut):
+        fut.add_done_callback(
+            lambda f: (lock.acquire(), done.append(name), lock.release())
+        )
+
+    t0 = time.monotonic()
+    lat = {"tight": [], "bulk": []}
+    futs = []
+    for x in _examples(48, seed=3):
+        f = eng.submit(x, slo_class="bulk")
+        watch("bulk", f)
+        futs.append(("bulk", t0, f))
+    for x in _examples(8, seed=4):
+        f = eng.submit(x, slo_class="tight")
+        watch("tight", f)
+        futs.append(("tight", t0, f))
+    eng.start()
+    try:
+        for name, t, f in futs:
+            f.result(timeout=120)
+            # e2e as the engine measured it (submit -> completion).
+            lat[name].append(f.e2e_latency_s)
+    finally:
+        eng.stop()
+    assert len(done) == 56
+    # Position of the last tight completion in the completion order.
+    last_tight = max(i for i, n in enumerate(done) if n == "tight")
+    return last_tight, lat
+
+
+def test_edf_tight_class_beats_fifo_former(model):
+    """ISSUE acceptance (tier-1, CPU): under the mixed load, EDF serves
+    every tight request before the bulk backlog (structural — the
+    completion order is deterministic given the queue content), so the
+    tight class's p99 beats the FIFO former's by construction."""
+    from mpi4dl_tpu.profiling import percentiles
+
+    last_tight_edf, lat_edf = _run_arm(model, "edf")
+    last_tight_fifo, lat_fifo = _run_arm(model, "fifo")
+    # EDF: all 8 tights complete within the first ~3 batches (the first
+    # two takes pop tight's earlier deadlines first). FIFO: the tights
+    # arrived last and complete last.
+    assert last_tight_edf < 16, (
+        f"EDF served the last tight request at completion position "
+        f"{last_tight_edf}; expected it near the front"
+    )
+    assert last_tight_fifo >= 48, (
+        f"FIFO served the last tight request at position "
+        f"{last_tight_fifo}; expected it behind the 48-deep bulk backlog"
+    )
+    p99_edf = percentiles(lat_edf["tight"], (99,))["p99"]
+    p99_fifo = percentiles(lat_fifo["tight"], (99,))["p99"]
+    assert p99_edf < p99_fifo, (
+        f"tight-class p99 {p99_edf * 1e3:.1f}ms (edf) !< "
+        f"{p99_fifo * 1e3:.1f}ms (fifo)"
+    )
+    # Aggregate service is preserved: both arms served everything.
+    assert len(lat_edf["bulk"]) == len(lat_fifo["bulk"]) == 48
+
+
+# -- fleet propagation --------------------------------------------------------
+
+
+def test_worker_predict_server_propagates_class():
+    """The slo_class a router sends rides the worker's /predict into
+    engine.submit (stub engine — no jax model needed)."""
+    from mpi4dl_tpu.fleet.replica import ReplicaClient
+    from mpi4dl_tpu.fleet.worker import _ChaosState, _predict_server
+
+    seen = {}
+
+    class StubEngine:
+        def submit(self, x, deadline_s=None, trace_id=None, slo_class=None):
+            seen["slo_class"] = slo_class
+            seen["shape"] = tuple(x.shape)
+            fut = Future()
+            fut.set_result(np.zeros((10,), np.float32))
+            fut.trace_id = trace_id
+            fut.e2e_latency_s = 0.001
+            return fut
+
+    httpd = _predict_server(
+        StubEngine(), _ChaosState(), threading.Event(), 0
+    )
+    try:
+        client = ReplicaClient(
+            "r0", f"http://127.0.0.1:{httpd.server_address[1]}"
+        )
+        logits, payload = client.predict(
+            np.zeros((4, 4, 3), np.float32), "tid-1",
+            deadline_s=5.0, timeout_s=5.0, slo_class="tight",
+        )
+    finally:
+        httpd.shutdown()
+    assert seen["slo_class"] == "tight"
+    assert payload["trace_id"] == "tid-1"
+    assert logits.shape == (10,)
+
+
+def test_router_sheds_deprioritized_class_under_pressure():
+    """ISSUE tentpole: the router applies the engine scheduler's OWN
+    shedding policy at its admission edge — same ClassFeedback, same
+    burn gauges, one policy."""
+    from mpi4dl_tpu.fleet.router import Router
+    from mpi4dl_tpu.serve.engine import DrainedError
+
+    reg = telemetry.MetricsRegistry()
+    burn = telemetry.declare(reg, "slo_burn_rate")
+    burn.set(20.0, slo="latency_tight", window="fast_long")
+    burn.set(0.1, slo="latency_bulk", window="fast_long")
+    router = Router(
+        example_shape=(4, 4, 3), registry=reg, max_queue=4,
+        slo_classes="tight=50ms@30s,bulk=2s@60s", shed_queue_ratio=0.5,
+    )
+    x = np.zeros((4, 4, 3), np.float32)
+    futs = [router.submit(x, slo_class="tight") for _ in range(2)]
+    # Queue at the shed threshold (2/4): bulk (deprioritized) sheds...
+    with pytest.raises(QueueFullError) as ei:
+        router.submit(x, slo_class="bulk")
+    assert ei.value.shed and ei.value.slo_class == "bulk"
+    assert reg.get("serve_class_shed_total").value(slo_class="bulk") == 1
+    # ...while tight still admits to the full bound.
+    futs.append(router.submit(x, slo_class="tight"))
+    assert router.stats()["shed"] == 1
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        router.submit(x, slo_class="nope")
+    router.stop(drain=False)
+    for f in futs:
+        with pytest.raises(DrainedError):
+            f.result(timeout=5)
