@@ -551,18 +551,30 @@ def _measure_sched_ab(cells, params, stats) -> dict:
 
 
 def _measure_fleet() -> dict:
-    """Fleet recovery extra (docs/FLEET.md): 2 replica subprocesses
-    behind the router under closed-loop load, ``kill -9`` one mid-run —
-    records throughput through the fault, the requeue count, and the
-    death-to-replacement recovery time (bench-history trends
-    ``fleet_2replica.recovery_s`` with the regression sign inverted).
-    The workers are pinned to the CPU backend: this bench process owns
-    the accelerator, and the mechanics under measurement — dispatch,
-    requeue, respawn — are host-side."""
+    """Fleet recovery extra (docs/FLEET.md): ONE fleet — 2 replica
+    subprocesses + 1 warm-pool standby behind 2 front-door router
+    processes — put through BOTH kill drills under closed-loop load:
+
+    - arm ``replica``: ``kill -9`` a serving replica mid-run; with the
+      warm pool on, recovery is a standby promotion (routing flip), so
+      ``recovery_s.replica`` is handshake-bound (< 2 s target on CPU vs
+      ~7 s warm-up-compile cold), and the pool backfills afterward;
+    - arm ``router``: ``kill -9`` a router process mid-run; the client
+      fails over (``router_failovers``), the supervisor respawns the
+      slot, and the successor replays the journal
+      (``journal_replays`` > 0); ``recovery_s.router`` is the router's
+      death-to-ready time.
+
+    bench-history trends ``recovery_s.replica`` and
+    ``recovery_s.router`` with the regression sign inverted. The
+    workers are pinned to the CPU backend: this bench process owns the
+    accelerator, and the mechanics under measurement — dispatch,
+    failover, journal replay, promotion — are host-side."""
     import signal as _signal
     import threading
 
-    from mpi4dl_tpu.fleet.router import Router
+    from mpi4dl_tpu.fleet.__main__ import _journal_replays
+    from mpi4dl_tpu.fleet.frontdoor import RouterSetClient
     from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
     from mpi4dl_tpu.serve.loadgen import run_closed_loop
 
@@ -573,65 +585,106 @@ def _measure_fleet() -> dict:
         PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
     )
     n_requests = 600
-    router = Router(
-        example_shape=(16, 16, 3), max_attempts=4,
-        inflight_per_replica=4, health_interval_s=0.1,
-        registry=_REGISTRY,
-    )
     sup = FleetSupervisor(
         ["--image-size", "16", "--max-batch", "2"],
-        router=router, replicas=2, max_replicas=2, env=env,
+        router=None, registry=_REGISTRY,
+        replicas=2, max_replicas=2, warm_pool=1,
+        routers=2,
+        router_args=["--image-size", "16", "--max-attempts", "4",
+                     "--inflight-per-replica", "4",
+                     "--health-interval", "0.1"],
+        env=env,
         reconcile_interval_s=0.1, backoff_base_s=0.1,
         backoff_max_s=0.5, spawn_timeout_s=420.0,
     )
+    client = None
     try:
         t0 = time.monotonic()
         sup.start()
         sup.wait_ready(timeout_s=420)
         startup_s = time.monotonic() - t0
-        rep: dict = {}
+        client = RouterSetClient(
+            sup.router_submit_urls(), example_shape=(16, 16, 3),
+            default_deadline_s=120.0,
+        )
 
-        def load():
-            rep.update(run_closed_loop(
-                router, n_requests, concurrency=12, deadline_s=120.0,
-            ))
+        def drill(kill) -> dict:
+            rep: dict = {}
 
-        t = threading.Thread(target=load)
-        t.start()
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
-            if router.stats()["served"] >= n_requests // 10:
-                break
-            time.sleep(0.01)
-        os.kill(sup.slot_by_index(1).pid, _signal.SIGKILL)
-        t.join(timeout=300)
+            def load():
+                rep.update(run_closed_loop(
+                    client, n_requests, concurrency=12, deadline_s=120.0,
+                ))
+
+            t = threading.Thread(target=load)
+            t.start()
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if client.stats()["submitted"] >= n_requests // 10:
+                    break
+                time.sleep(0.01)
+            kill()
+            t.join(timeout=300)
+            return rep
+
+        # Arm 1 — replica kill with the warm pool on: recovery is a
+        # promotion, and the pool backfills (cold) afterward.
+        rep_a = drill(lambda: os.kill(
+            sup.slot_by_index(1).pid, _signal.SIGKILL
+        ))
+        recovery_replica = sup.last_recovery_s
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
-            if sup.running_count() == 2:
+            if (sup.running_count() == 2 and sup.standby_count() == 1):
                 break
             time.sleep(0.2)
-        stats = router.stats()
+        backfilled = sup.standby_count() == 1
+
+        # Arm 2 — router kill: client failover + journal replay on the
+        # respawned slot.
+        rep_b = drill(lambda: os.kill(
+            sup.router_slot_by_index(1).pid, _signal.SIGKILL
+        ))
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if sup.running_router_count() == 2:
+                break
+            time.sleep(0.2)
+        recovery_router = sup.last_router_recovery_s
+        replays = _journal_replays(sup)
         return {
-            "value": round(rep["throughput_rps"], 1),
-            "unit": "requests/sec through a kill -9 drill",
-            "served": rep["served"],
-            "offered": n_requests,
-            "errors": rep["errors"],
-            "requeued": stats["requeued"],
+            "value": round(rep_a["throughput_rps"], 1),
+            "unit": "requests/sec through a kill -9 replica drill "
+                    "(HTTP front door, warm pool on)",
+            "served": rep_a["served"] + rep_b["served"],
+            "offered": 2 * n_requests,
+            "errors": rep_a["errors"] + rep_b["errors"],
+            "router_kill_rps": round(rep_b["throughput_rps"], 1),
+            "router_failovers": rep_b.get("router_failovers", 0),
+            "journal_replays": replays,
+            "promotions": sup.promotions,
+            "pool_backfilled": backfilled,
             "restarts": sup.restarts,
-            "recovery_s": (
-                round(sup.last_recovery_s, 2)
-                if sup.last_recovery_s is not None else None
-            ),
+            "recovery_s": {
+                "replica": (
+                    round(recovery_replica, 2)
+                    if recovery_replica is not None else None
+                ),
+                "router": (
+                    round(recovery_router, 2)
+                    if recovery_router is not None else None
+                ),
+            },
             "startup_s": round(startup_s, 2),
             "latency_ms": {
                 k: round(v * 1e3, 2)
-                for k, v in rep["latency_s"].items() if v is not None
+                for k, v in rep_a["latency_s"].items() if v is not None
             },
         }
     finally:
         sup.close()
-        router.stop(drain=False)
+        if client is not None:
+            client.close()
 
 
 def _measure_sp_overlap() -> dict:
@@ -1088,7 +1141,7 @@ def main():
     # Fleet recovery drill (router + 2 CPU replica subprocesses + kill
     # -9): rps-through-the-fault, requeue count, recovery latency.
     if os.environ.get("BENCH_FLEET", "1") != "0":
-        run_extra("fleet_2replica", _measure_fleet, est_seconds=120.0)
+        run_extra("fleet_2replica", _measure_fleet, est_seconds=240.0)
 
     # SP 2x2 halo/compute overlap A/B (CPU-mesh subprocess): both conv
     # impls' measured trace_overlap_ratio + step time in one round, so

@@ -106,8 +106,15 @@ def extract_series(result: dict) -> "dict[str, float]":
                     out[f"{name}.peak_hbm_bytes[b{b}]"] = float(v)
         # Fleet extra: death-to-replacement latency, trended so a
         # slower recovery (a grown number) reads as the regression.
-        if isinstance(entry.get("recovery_s"), (int, float)):
-            out[f"{name}.recovery_s"] = float(entry["recovery_s"])
+        # A plain float is the pre-HA shape; the HA drill records one
+        # per failure domain ({"replica": ..., "router": ...}).
+        recovery = entry.get("recovery_s")
+        if isinstance(recovery, (int, float)):
+            out[f"{name}.recovery_s"] = float(recovery)
+        elif isinstance(recovery, dict):
+            for kind, v in recovery.items():
+                if isinstance(v, (int, float)):
+                    out[f"{name}.recovery_s.{kind}"] = float(v)
         # Serving extra: tail shape (p99/p50), trended with the
         # inverted sign — a growing tail is the regression even when
         # mean throughput holds.
@@ -157,7 +164,7 @@ def lower_is_better(key: str) -> bool:
     direction: FALLING overlap fails CI)."""
     return (
         "peak_hbm_bytes" in key
-        or key.endswith(".recovery_s")
+        or ".recovery_s" in key
         or ".step_time_s" in key
         or key.endswith(".tail_p99_p50_ratio")
         or ".sched_tight_p99_ms" in key

@@ -16,8 +16,19 @@ package actuates on them:
 - :mod:`.worker` — the replica process (``python -m
   mpi4dl_tpu.fleet.worker``): one ServingEngine + predict RPC endpoint
   + the chaos hooks;
-- :mod:`.chaos` — the fault-injection harness (``--chaos kill:1``...):
-  the drills the tier-1 tests run, on tap against a live fleet;
+- :mod:`.frontdoor` — the HA front door: the router AS a supervised
+  process (``python -m mpi4dl_tpu.fleet.frontdoor``, no JAX — respawn
+  is handshake-bound) plus :class:`RouterSetClient`, the failover
+  client over an N-router set (``router_failovers`` on
+  connection-refused, the typed :class:`FleetUnreachableError` only
+  when every router is down);
+- :mod:`.journal` — the router's fsync'd recovery journal: a successor
+  replays a dead router's accepted-but-uncompleted requests, dedupes
+  against replica-reported completions, and re-dispatches the rest
+  with fresh epochs (exactly-once across the ROUTER failure domain);
+- :mod:`.chaos` — the fault-injection harness (``--chaos kill:1``,
+  ``--chaos kill:router``...): the drills the tier-1 tests run, on tap
+  against a live fleet;
 - ``python -m mpi4dl_tpu.fleet`` — spawn a fleet, load it, optionally
   break it, print one JSON report.
 
@@ -31,7 +42,17 @@ from mpi4dl_tpu.fleet.chaos import (  # noqa: F401
     parse_chaos_spec,
     parse_chaos_specs,
 )
+from mpi4dl_tpu.fleet.frontdoor import (  # noqa: F401
+    RouterAdminClient,
+    RouterServer,
+    RouterSetClient,
+    router_cmd,
+)
+from mpi4dl_tpu.fleet.journal import (  # noqa: F401
+    RouterJournal,
+)
 from mpi4dl_tpu.fleet.replica import (  # noqa: F401
+    FleetUnreachableError,
     ReplicaClient,
     ReplicaDeadline,
     ReplicaError,

@@ -18,6 +18,13 @@ Examples::
         --requests 128 --concurrency 16
     JAX_PLATFORMS=cpu python -m mpi4dl_tpu.fleet --replicas 2 \
         --chaos kill:1@1.5 --requests 256 --json /tmp/drill.json
+    # HA front door: 2 router processes, kill one mid-load — the client
+    # fails over, the successor replays the dead router's journal:
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.fleet --replicas 2 \
+        --routers 2 --chaos kill:router@1.5 --requests 256
+    # Warm pool: replica deaths promote a standby instead of respawning:
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.fleet --replicas 2 \
+        --warm-pool 1 --chaos kill:1@1.5 --requests 256
 """
 
 from __future__ import annotations
@@ -39,14 +46,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="initial replica count (the autoscale floor)")
     p.add_argument("--max-replicas", type=int, default=None,
                    help="autoscale ceiling (default: --replicas)")
+    p.add_argument("--routers", type=int, default=1,
+                   help="front-door router PROCESSES (the HA front "
+                        "door; each journals for router-death replay). "
+                        "0 = one in-process router (the pre-HA shape)")
+    p.add_argument("--warm-pool", type=int, default=0,
+                   help="extra replicas kept warm but unrouted; a "
+                        "replica death promotes one (routing flip) "
+                        "instead of paying a cold spawn")
+    p.add_argument("--replay-grace", type=float, default=1.5,
+                   help="seconds a successor router parks journal "
+                        "orphans polling replica served-caches before "
+                        "re-dispatching")
     p.add_argument("--chaos", action="append", default=[],
                    metavar="SPEC",
                    help="fault injection, repeatable: "
                         "ACTION[:TARGET][=SECONDS][@AT] with actions "
                         "kill, wedge, blackhole, delay-scrape, delay — "
                         "e.g. kill:1@1.5 (SIGKILL replica 1, 1.5s into "
-                        "load) or delay:1=0.3 (straggler: slow replica "
-                        "1's serving path by 0.3s per batch)")
+                        "load), kill:router (SIGKILL router 0 — the "
+                        "successor replays its journal), or "
+                        "delay:1=0.3 (straggler: slow replica 1's "
+                        "serving path by 0.3s per batch)")
     p.add_argument("--plan", action="store_true",
                    help="print the fleet plan as JSON and exit without "
                         "spawning anything (pure dispatch)")
@@ -113,19 +134,32 @@ def plan(args) -> dict:
     from mpi4dl_tpu.fleet.chaos import parse_chaos_specs
     from mpi4dl_tpu.fleet.replica import worker_cmd
 
+    from mpi4dl_tpu.fleet.frontdoor import router_cmd
+
     ops = parse_chaos_specs(args.chaos)
     for op in ops:
-        if op.target >= args.replicas:
+        if op.domain == "router":
+            if op.target >= max(args.routers, 0):
+                raise ValueError(
+                    f"chaos target router{op.target} outside --routers "
+                    f"{args.routers}"
+                )
+        elif op.target >= args.replicas + args.warm_pool:
             raise ValueError(
                 f"chaos target r{op.target} outside --replicas "
-                f"{args.replicas}"
+                f"{args.replicas} (+{args.warm_pool} warm pool)"
             )
     return {
         "replicas": args.replicas,
         "max_replicas": args.max_replicas or args.replicas,
+        "routers": args.routers,
+        "warm_pool": args.warm_pool,
         "mode": args.mode,
         "chaos": [op.describe() for op in ops],
         "worker_cmd": worker_cmd(_worker_args(args)),
+        "router_cmd": (
+            router_cmd(_router_args(args)) if args.routers else None
+        ),
         "federation": not args.no_federation,
     }
 
@@ -146,6 +180,43 @@ def _worker_args(args) -> "list[str]":
     return out
 
 
+def _router_args(args) -> "list[str]":
+    out = [
+        "--image-size", str(args.image_size),
+        "--max-queue", str(args.max_queue),
+        "--max-attempts", str(args.max_attempts),
+        "--inflight-per-replica", str(args.inflight_per_replica),
+        "--default-deadline-s", str(args.deadline_ms / 1e3),
+        "--replay-grace", str(args.replay_grace),
+    ]
+    if args.telemetry_dir:
+        out += ["--telemetry-dir", args.telemetry_dir]
+    if args.slo_classes:
+        out += ["--slo-classes", args.slo_classes]
+    return out
+
+
+def _journal_replays(sup) -> "dict | None":
+    """Sum fleet_router_journal_replays_total across the running router
+    processes' /snapshotz — the CLI-report twin of the drill assertion."""
+    import urllib.request
+
+    out: "dict[str, float]" = {}
+    for name, url in sup.router_metrics_urls().items():
+        try:
+            with urllib.request.urlopen(url + "/snapshotz", timeout=5) as r:
+                snap = json.loads(r.read().decode())
+        except Exception:  # noqa: BLE001 — a mid-restart router
+            continue
+        metric = snap.get("metrics", {}).get(
+            "fleet_router_journal_replays_total"
+        )
+        for series in (metric or {}).get("series", ()):
+            key = series.get("labels", {}).get("outcome", "total")
+            out[key] = out.get(key, 0) + series.get("value", 0)
+    return out or None
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -161,6 +232,7 @@ def main(argv=None) -> int:
 
     from mpi4dl_tpu import telemetry
     from mpi4dl_tpu.fleet.chaos import ChaosMonkey, parse_chaos_specs
+    from mpi4dl_tpu.fleet.frontdoor import RouterSetClient
     from mpi4dl_tpu.fleet.router import Router
     from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
     from mpi4dl_tpu.serve.loadgen import run_closed_loop, run_open_loop
@@ -172,15 +244,19 @@ def main(argv=None) -> int:
               flush=True)
 
     size = args.image_size
-    router = Router(
-        example_shape=(size, size, 3),
-        max_queue=args.max_queue,
-        default_deadline_s=args.deadline_ms / 1e3,
-        max_attempts=args.max_attempts,
-        inflight_per_replica=args.inflight_per_replica,
-        telemetry_dir=args.telemetry_dir,
-        slo_classes=args.slo_classes,
-    )
+    router = None
+    if args.routers <= 0:
+        # The pre-HA shape: one in-process router (no failure domain of
+        # its own, but also no HTTP hop for the client).
+        router = Router(
+            example_shape=(size, size, 3),
+            max_queue=args.max_queue,
+            default_deadline_s=args.deadline_ms / 1e3,
+            max_attempts=args.max_attempts,
+            inflight_per_replica=args.inflight_per_replica,
+            telemetry_dir=args.telemetry_dir,
+            slo_classes=args.slo_classes,
+        )
     federation = None
     if not args.no_federation:
         federation = telemetry.SLOConfig(
@@ -193,6 +269,9 @@ def main(argv=None) -> int:
     sup = FleetSupervisor(
         _worker_args(args),
         router=router,
+        routers=max(args.routers, 0),
+        router_args=_router_args(args) if args.routers > 0 else None,
+        warm_pool=args.warm_pool,
         replicas=args.replicas,
         max_replicas=args.max_replicas or args.replicas,
         federation=federation,
@@ -205,13 +284,14 @@ def main(argv=None) -> int:
     if args.metrics_port is not None:
         registry = (
             sup.aggregator.registry if sup.aggregator is not None
-            else router.registry
+            else (router.registry if router is not None else sup.registry)
         )
         server = telemetry.MetricsServer(
             registry, port=args.metrics_port,
-            health=router.health_snapshot,
+            health=(router.health_snapshot if router is not None else None),
             debug=lambda: {
-                "router": router.stats(), "supervisor": sup.state(),
+                "router": router.stats() if router is not None else None,
+                "supervisor": sup.state(),
             },
         )
         print(
@@ -223,16 +303,30 @@ def main(argv=None) -> int:
     report = {"fleet": the_plan}
     rc = 0
     monkey = None
+    client = None
     try:
         t_up = time.monotonic()
         sup.start()
         sup.wait_ready(timeout_s=args.spawn_timeout)
         report["fleet"]["startup_s"] = time.monotonic() - t_up
         print(
-            f"# fleet up: {sup.running_count()} replica(s) in "
+            f"# fleet up: {sup.running_count()} replica(s), "
+            f"{sup.standby_count()} standby, "
+            f"{sup.running_router_count()} router(s) in "
             f"{report['fleet']['startup_s']:.1f}s",
             file=sys.stderr, flush=True,
         )
+        if router is not None:
+            target = router
+        else:
+            # The client-side half of the HA front door: failover across
+            # the router set, same loadgen surface as one engine.
+            target = client = RouterSetClient(
+                sup.router_submit_urls(),
+                example_shape=(size, size, 3),
+                default_deadline_s=args.deadline_ms / 1e3,
+                telemetry_dir=args.telemetry_dir,
+            )
 
         monkey = ChaosMonkey(parse_chaos_specs(args.chaos), sup)
         monkey.start()
@@ -243,50 +337,69 @@ def main(argv=None) -> int:
             mix_kw["class_mix"] = ClassMix.parse(args.class_mix)
         if args.mode == "closed":
             report["loadgen"] = run_closed_loop(
-                router, args.requests, concurrency=args.concurrency,
-                deadline_s=args.deadline_ms / 1e3, events=router.events,
+                target, args.requests, concurrency=args.concurrency,
+                deadline_s=args.deadline_ms / 1e3, events=target.events,
                 queue_full_retries=args.queue_full_retries, **mix_kw,
             )
         else:
             report["loadgen"] = run_open_loop(
-                router, rate_rps=args.rate, duration_s=args.duration,
-                deadline_s=args.deadline_ms / 1e3, events=router.events,
+                target, rate_rps=args.rate, duration_s=args.duration,
+                deadline_s=args.deadline_ms / 1e3, events=target.events,
                 queue_full_retries=args.queue_full_retries, **mix_kw,
             )
 
         # Post-load: the drill isn't over until every scheduled chaos op
         # has actually fired (a fast load run must not outrun its own
-        # drill) AND the supervisor has restored the fleet (or the
-        # recovery window expires — reported either way, failed loudly
-        # when chaos was requested).
+        # drill) AND the supervisor has restored the fleet — serving
+        # replicas, warm pool, AND the router set (or the recovery
+        # window expires — reported either way, failed loudly when
+        # chaos was requested).
+        def _restored() -> bool:
+            return (
+                sup.running_count() >= sup.desired_replicas()
+                and sup.standby_count() >= args.warm_pool
+                and sup.running_router_count() >= max(args.routers, 0)
+            )
+
         deadline = time.monotonic() + args.recovery_timeout
         n_ops = len(monkey.ops)
         while time.monotonic() < deadline and len(monkey.log) < n_ops:
             time.sleep(0.1)
         while time.monotonic() < deadline:
-            if (
-                len(monkey.log) >= n_ops
-                and sup.running_count() >= sup.desired_replicas()
-            ):
+            if len(monkey.log) >= n_ops and _restored():
                 break
             time.sleep(0.25)
-        restored = sup.running_count() >= sup.desired_replicas()
+        restored = _restored()
         report["chaos"] = monkey.log
         report["supervisor"] = sup.state()
-        report["router"] = router.stats()
+        report["router"] = (
+            router.stats() if router is not None else client.stats()
+        )
+        report["router_failovers"] = report["loadgen"].get(
+            "router_failovers", 0
+        )
+        if args.routers > 0:
+            report["journal_replays"] = _journal_replays(sup)
         if sup.aggregator is not None:
             # Straggler view (a `delay` drill's verdict surface): which
             # replica drags the fleet tail, per the federated skew score.
             report["straggler"] = sup.aggregator.straggler_state()
         report["recovered"] = restored
-        report["recovery_s"] = sup.last_recovery_s
+        report["recovery_s"] = {
+            "replica": sup.last_recovery_s,
+            "router": sup.last_router_recovery_s,
+        }
+        report["promotions"] = sup.promotions
         if args.chaos and not restored:
             rc = 1
     finally:
         if monkey is not None:
             monkey.close()
         sup.close()
-        router.stop(drain=False)
+        if client is not None:
+            client.close()
+        if router is not None:
+            router.stop(drain=False)
         if server is not None:
             server.close()
 

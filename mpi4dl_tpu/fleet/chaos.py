@@ -29,14 +29,20 @@ Spec grammar (``--chaos``, repeatable)::
     ACTION[:TARGET][@AT[s]]
 
     kill:1          SIGKILL replica index 1 (at the default +1.0s)
+    kill:router     SIGKILL router process 0 (the ROUTER failure domain:
+                    the successor replays the journal — docs/FLEET.md)
+    kill:router:1   SIGKILL router process 1
     wedge:0@2.5     wedge replica 0's batcher 2.5s into the load run
     delay-scrape:1=3@2   delay r1's /snapshotz by 3s from t=+2s
     delay:1=0.3@2   slow r1's serving path by 0.3s/batch from t=+2s
 
-``TARGET`` is the replica *slot index* (default 0); ``AT`` is seconds
-after the load run starts; ``=SECONDS`` (delay / delay-scrape) is the
-added latency. Parsing is pure stdlib — ``--plan`` dispatch and the CLI
-smoke never touch a backend.
+``TARGET`` is the replica *slot index* (default 0) — or
+``router[:INDEX]`` to target a front-door router process instead
+(``kill`` only: routers have no in-process ``/chaos`` surface; their
+failure mode IS hard death). ``AT`` is seconds after the load run
+starts; ``=SECONDS`` (delay / delay-scrape) is the added latency.
+Parsing is pure stdlib — ``--plan`` dispatch and the CLI smoke never
+touch a backend.
 """
 
 from __future__ import annotations
@@ -50,7 +56,7 @@ ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape", "delay")
 
 _SPEC_RE = re.compile(
     r"^(?P<action>[a-z-]+)"
-    r"(?::(?P<target>\d+))?"
+    r"(?::(?P<target>router(?::\d+)?|\d+))?"
     r"(?:=(?P<seconds>\d+(?:\.\d+)?))?"
     r"(?:@(?P<at>\d+(?:\.\d+)?)s?)?$"
 )
@@ -61,15 +67,24 @@ class ChaosOp:
     """One scheduled fault injection."""
 
     action: str
-    target: int = 0        # replica slot index
+    target: int = 0        # slot index within the target domain
     at_s: float = 1.0      # seconds after the load run starts
     seconds: float = 3.0   # delay-scrape only: added latency
+    domain: str = "replica"  # "replica" | "router" (the failure domain)
 
     def __post_init__(self):
         if self.action not in ACTIONS:
             raise ValueError(
                 f"unknown chaos action {self.action!r}; expected one of "
                 f"{ACTIONS}"
+            )
+        if self.domain not in ("replica", "router"):
+            raise ValueError(f"unknown chaos domain {self.domain!r}")
+        if self.domain == "router" and self.action != "kill":
+            raise ValueError(
+                f"router chaos supports only 'kill' (got "
+                f"{self.action!r}): routers have no /chaos surface — "
+                "their failure mode is hard death"
             )
         if self.target < 0 or self.at_s < 0 or self.seconds <= 0:
             raise ValueError(f"invalid chaos op: {self}")
@@ -79,22 +94,31 @@ class ChaosOp:
             f"={self.seconds:g}s"
             if self.action in ("delay-scrape", "delay") else ""
         )
-        return f"{self.action}:r{self.target}{extra}@+{self.at_s:g}s"
+        prefix = "router" if self.domain == "router" else "r"
+        return f"{self.action}:{prefix}{self.target}{extra}@+{self.at_s:g}s"
 
 
 def parse_chaos_spec(spec: str) -> ChaosOp:
-    """``ACTION[:TARGET][=SECONDS][@AT]`` → :class:`ChaosOp`; raises
+    """``ACTION[:TARGET][=SECONDS][@AT]`` → :class:`ChaosOp` (``TARGET``
+    may be ``router[:N]`` for the router failure domain); raises
     ``ValueError`` naming the problem (argparse turns it into a usage
     error)."""
     m = _SPEC_RE.match(spec.strip())
     if not m:
         raise ValueError(
             f"bad chaos spec {spec!r}; expected ACTION[:TARGET][=SECONDS]"
-            f"[@AT], e.g. kill:1 or wedge:0@2.5 (actions: {ACTIONS})"
+            f"[@AT], e.g. kill:1, kill:router, or wedge:0@2.5 "
+            f"(actions: {ACTIONS})"
         )
     kw = {"action": m.group("action")}
-    if m.group("target") is not None:
-        kw["target"] = int(m.group("target"))
+    target = m.group("target")
+    if target is not None:
+        if target.startswith("router"):
+            kw["domain"] = "router"
+            _, _, idx = target.partition(":")
+            kw["target"] = int(idx) if idx else 0
+        else:
+            kw["target"] = int(target)
     if m.group("at") is not None:
         kw["at_s"] = float(m.group("at"))
     if m.group("seconds") is not None:
@@ -109,8 +133,19 @@ def parse_chaos_specs(specs) -> "list[ChaosOp]":
 def inject(op: ChaosOp, supervisor) -> dict:
     """Apply one op against a live fleet NOW. ``kill`` goes straight to
     the OS (the point is that the victim gets no say); the soft faults
-    go through the victim's own ``/chaos`` endpoint. Returns a record of
-    what was done (the CLI report embeds it)."""
+    go through the victim's own ``/chaos`` endpoint. ``domain="router"``
+    targets a front-door router slot instead of a replica. Returns a
+    record of what was done (the CLI report embeds it)."""
+    if op.domain == "router":
+        slot = supervisor.router_slot_by_index(op.target)
+        if slot is None:
+            raise ValueError(
+                f"chaos target router index {op.target} has no live router"
+            )
+        record = {"op": op.describe(), "router": slot.name,
+                  "pid": slot.pid, "ts": time.time()}
+        slot.kill_hard()
+        return record
     slot = supervisor.slot_by_index(op.target)
     if slot is None:
         raise ValueError(

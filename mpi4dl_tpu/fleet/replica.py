@@ -72,6 +72,19 @@ class ReplicaRemoteError(ReplicaError):
     """The request failed inside the replica's engine."""
 
 
+class FleetUnreachableError(ReplicaError):
+    """EVERY front-door router is currently unreachable (all marked down
+    by recent connection-refused/reset). Retriable — the supervisor
+    respawns routers — so it carries the same ``retry_after_s`` hint
+    shape as :class:`~mpi4dl_tpu.serve.QueueFullError`, and the load
+    generator's backoff-retry loop treats it accordingly (counted as
+    ``router_failovers``, not queue pressure)."""
+
+    def __init__(self, msg: str, retry_after_s: "float | None" = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class ReplicaClient:
     """Blocking HTTP client for one replica's predict/chaos surface."""
 
@@ -95,11 +108,16 @@ class ReplicaClient:
         deadline_s: float,
         timeout_s: float,
         slo_class: "str | None" = None,
+        retried: bool = False,
     ) -> "tuple[np.ndarray, dict]":
         """One blocking predict RPC; returns ``(logits, payload)`` or
         raises one of the typed errors above. ``slo_class`` propagates
         the router-side SLO class into the replica engine's scheduler
-        (the worker's engine must declare the same classes)."""
+        (the worker's engine must declare the same classes).
+        ``retried=True`` marks a failover retry whose earlier attempt
+        MAY have executed — a front-door router receiving it probes the
+        replicas' served-caches before dispatching (duplicate
+        suppression across the router failure domain)."""
         payload = {
             "trace_id": trace_id,
             "deadline_s": float(deadline_s),
@@ -110,6 +128,8 @@ class ReplicaClient:
         }
         if slo_class is not None:
             payload["slo_class"] = str(slo_class)
+        if retried:
+            payload["retried"] = True
         try:
             out = self._post("/predict", payload, timeout_s)
         except urllib.error.HTTPError as e:
@@ -151,6 +171,20 @@ class ReplicaClient:
     def chaos(self, timeout_s: float = 5.0, **payload) -> dict:
         """Apply a soft fault via the worker's ``/chaos`` endpoint."""
         return self._post("/chaos", payload, timeout_s)
+
+    def served(
+        self, trace_ids, timeout_s: float = 2.0
+    ) -> "list[str]":
+        """Which of ``trace_ids`` this replica has served (idempotency
+        cache) or currently has in flight — the dedupe probe a successor
+        router runs over journal orphans before re-dispatching them.
+        Raises the usual typed errors on transport failure (the caller
+        treats an unanswerable replica as 'cannot vouch')."""
+        out = self._post(
+            "/served", {"trace_ids": [str(t) for t in trace_ids]},
+            timeout_s,
+        )
+        return list(out.get("served", ()))
 
 
 class ReplicaProcess:
@@ -262,6 +296,15 @@ class ReplicaProcess:
     @property
     def returncode(self) -> "int | None":
         return self.proc.returncode if self.proc is not None else None
+
+    def spawned_age_s(self) -> float:
+        """Seconds since spawn() on THIS process's monotonic clock — the
+        spawn-timeout input. Kept here (rather than supervisor-side
+        ``clock() - spawned_at`` arithmetic) so an injected supervisor
+        test clock can never be subtracted from a real monotonic stamp."""
+        if self.spawned_at is None:
+            return 0.0
+        return time.monotonic() - self.spawned_at
 
     def heartbeat_stale_s(self) -> "float | None":
         """Seconds since the last observed heartbeat mtime CHANGE (the

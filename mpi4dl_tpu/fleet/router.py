@@ -88,6 +88,7 @@ ROUTER_METRICS = (
     "fleet_inflight",
     "fleet_replicas",
     "fleet_request_latency_seconds",
+    "fleet_router_journal_replays_total",
 )
 
 
@@ -100,7 +101,7 @@ class _Record:
     __slots__ = (
         "x", "submit_t", "deadline", "future", "trace_id", "slo_class",
         "lock", "state", "epoch", "attempts", "history",
-        "first_dispatch_t", "last_error",
+        "first_dispatch_t", "last_error", "replayed",
     )
 
     def __init__(self, x, submit_t, deadline, future, trace_id,
@@ -118,6 +119,7 @@ class _Record:
         self.history: "list[str]" = []
         self.first_dispatch_t: "float | None" = None
         self.last_error: "Exception | None" = None
+        self.replayed = False
 
 
 class _Replica:
@@ -185,6 +187,25 @@ class Router:
     events / telemetry_dir: span-segment sink (``events`` wins; a
         shared :class:`telemetry.JsonlWriter` lets the in-process load
         generator's client segments land in the same file).
+    name: this router's stable identity (journal file + span attrs);
+        an N-router front door gives each instance its own name so a
+        respawned incarnation finds its predecessor's journal.
+    journal_path: append-only recovery journal (:mod:`.journal`). When
+        set, every accepted request and terminal delivery is journaled
+        (accept/done fsync'd), and :meth:`replay_journal` lets a
+        successor re-dispatch what a dead predecessor stranded. None
+        (default) keeps the in-memory-only PR-8 behavior.
+    replay_grace_s: how long a replay parks orphans while polling the
+        replicas' served-cache before re-dispatching — the window in
+        which a client's own failover retry normally completes the
+        request on a surviving router, making the orphan a dedupe
+        no-op instead of a second execution.
+    load_slack: load-aware pull. A replica whose scraped ``queue_depth``
+        exceeds the least-loaded accepting replica's by more than this
+        stops pulling until it drains back — with N shared-nothing
+        routers over one replica set, this is what keeps two routers
+        from piling onto the same replica (each router reads the same
+        enriched ``/healthz`` depth). None disables.
     slo_classes: named SLO classes (spec string / SLOClass sequence /
         None — :mod:`mpi4dl_tpu.serve.scheduler`). ``submit(slo_class=)``
         validates against them and the class rides every replica RPC, so
@@ -217,11 +238,17 @@ class Router:
         telemetry_dir: "str | None" = None,
         slo_classes=None,
         shed_queue_ratio: float = 0.5,
+        name: str = "router",
+        journal_path: "str | None" = None,
+        journal_fsync: bool = True,
+        replay_grace_s: float = 1.5,
+        load_slack: "int | None" = 4,
     ):
         from mpi4dl_tpu.serve.scheduler import (
             ClassFeedback,
             normalize_classes,
         )
+        self.name = str(name)
         self.example_shape = tuple(int(d) for d in example_shape)
         self._np_dtype = np.dtype(dtype)
         self.registry = (
@@ -279,6 +306,18 @@ class Router:
         self._m_replicas = telemetry.declare(self.registry, "fleet_replicas")
         self._m_replicas.set(0, state="configured")
         self._m_replicas.set(0, state="healthy")
+        self._m_replays = telemetry.declare(
+            self.registry, "fleet_router_journal_replays_total"
+        )
+
+        self._replay_grace_s = float(replay_grace_s)
+        self._load_slack = None if load_slack is None else int(load_slack)
+        self._journal = None
+        if journal_path:
+            from mpi4dl_tpu.fleet.journal import RouterJournal
+
+            self._journal = RouterJournal(journal_path, fsync=journal_fsync)
+        self._replay_thread: "threading.Thread | None" = None
 
         self._cond = threading.Condition()
         self._pending: "collections.deque[_Record]" = collections.deque()
@@ -287,7 +326,7 @@ class Router:
         self._counts = {
             "submitted": 0, "served": 0, "failed": 0,
             "rejected_queue_full": 0, "rejected_deadline": 0,
-            "drained": 0, "requeued": 0, "shed": 0,
+            "drained": 0, "requeued": 0, "shed": 0, "replayed": 0,
         }
         self._latencies: "list[float]" = []
         self._stopping = False
@@ -459,6 +498,14 @@ class Router:
                 )
             self._pending.append(rec)
             self._cond.notify()
+        if self._journal is not None:
+            # Durable accept (fsync'd) OUTSIDE the queue lock: a router
+            # killed after this line replays the request; killed before
+            # it, the client's own failover retry covers the request and
+            # the replica-side idempotency cache dedupes the overlap.
+            self._journal.accept(
+                rec.trace_id, x, deadline_s, slo_class=cls.name
+            )
         with self._lock:
             self._counts["submitted"] += 1
         return rec.future
@@ -505,6 +552,8 @@ class Router:
         with self._cond:
             self._cond.notify_all()
         self._scrape_thread.join(timeout=5)
+        if self._replay_thread is not None:
+            self._replay_thread.join(timeout=5)
         from mpi4dl_tpu.serve.engine import DrainedError
 
         while True:
@@ -516,16 +565,170 @@ class Router:
                 if rec.state == "done":
                     continue
                 rec.state = "done"
+            self._journal_done(rec, "drained")
             with self._lock:
                 self._counts["drained"] += 1
             self._m_requests.inc(outcome="drained")
             rec.future.set_exception(DrainedError(
                 "router stopped before this request was dispatched"
             ))
+        if self._journal is not None:
+            self._journal.close()
         if self._owns_events:
             self._events.close()
 
+    def fetch_served(self, trace_id: str, x,
+                     deadline_s: float = 5.0) -> "tuple | None":
+        """Duplicate-suppression probe for a RETRIED request (a client
+        failing over after a router death cannot know whether its first
+        attempt executed): ask each replica's served-cache whether it
+        vouches for ``trace_id``; if one does, fetch the CACHED result
+        from that same replica (its ``/predict`` answers from the cache
+        or joins the in-flight future — it never re-executes). Returns
+        ``(logits, payload)`` or None (no replica can vouch — the caller
+        submits normally and the request executes for the first time on
+        THIS side of the failover)."""
+        with self._lock:
+            reps = [r for r in self._replicas.values() if not r.removed]
+        for rep in reps:
+            try:
+                if trace_id not in rep.client.served([trace_id]):
+                    continue
+                out = rep.client.predict(
+                    x, trace_id, deadline_s=deadline_s,
+                    timeout_s=deadline_s + 1.0,
+                )
+            except Exception:  # noqa: BLE001 — a replica that cannot
+                continue  # vouch (or died holding the cache) proves
+                # nothing; the normal submit path takes over
+            self._m_requests.inc(outcome="served_cached")
+            return out
+        return None
+
+    # -- journal replay (router-death recovery) -------------------------------
+
+    def replay_journal(self) -> int:
+        """Process what a dead predecessor's journal stranded. Orphans
+        (accepted, never completed) are PARKED first: for up to
+        ``replay_grace_s`` the replay thread polls every registered
+        replica's served-cache — an orphan a replica already served (or
+        has in flight: the client's failover retry on a surviving
+        router) is completed in the journal as a dedupe no-op, never
+        re-executed. What remains after the grace is re-dispatched with
+        a fresh request epoch through the normal dispatch machinery
+        (the replica-side idempotency cache still backstops any residual
+        overlap). Returns the orphan count parked; every processed
+        orphan lands in ``fleet_router_journal_replays_total{outcome=
+        deduped|redispatched|expired}``."""
+        if self._journal is None:
+            return 0
+        recovered = self._journal.recovered
+        for _ in range(recovered.expired):
+            self._m_replays.inc(outcome="expired")
+        if not recovered.orphans:
+            return 0
+        self._replay_thread = threading.Thread(
+            target=self._replay_loop, args=(list(recovered.orphans),),
+            name=f"mpi4dl-router-replay-{self.name}", daemon=True,
+        )
+        self._replay_thread.start()
+        return len(recovered.orphans)
+
+    def _replay_loop(self, parked) -> None:
+        # A fresh successor has an empty replica map until the supervisor
+        # re-registers the fleet; the dedupe grace only means something
+        # once there is someone to ask, so wait (bounded) for the first
+        # registration before starting the clock.
+        wait_deadline = time.monotonic() + max(10.0, self._replay_grace_s)
+        while not self._stopping and time.monotonic() < wait_deadline:
+            with self._lock:
+                if self._replicas:
+                    break
+            time.sleep(0.05)
+        grace_deadline = time.monotonic() + self._replay_grace_s
+        while parked and not self._stopping:
+            tids = [o.trace_id for o in parked]
+            found: "set[str]" = set()
+            with self._lock:
+                reps = [r for r in self._replicas.values() if not r.removed]
+            for rep in reps:
+                try:
+                    found.update(rep.client.served(tids))
+                except Exception:  # noqa: BLE001 — an unreachable replica
+                    pass  # just can't vouch; the grace window bounds us
+            still = []
+            for o in parked:
+                if o.trace_id in found:
+                    self._journal.done(o.trace_id, "served")
+                    self._m_replays.inc(outcome="deduped")
+                    with self._lock:
+                        self._counts["replayed"] += 1
+                else:
+                    still.append(o)
+            parked = still
+            if time.monotonic() >= grace_deadline:
+                break
+            time.sleep(min(0.2, max(0.0,
+                                    grace_deadline - time.monotonic())))
+        for o in parked:
+            if self._stopping:
+                return
+            self._redispatch_orphan(o)
+
+    def _redispatch_orphan(self, orphan) -> None:
+        from concurrent.futures import Future
+
+        remaining = orphan.remaining_s()
+        if remaining <= 0:
+            self._journal.done(orphan.trace_id, "rejected_deadline")
+            self._m_replays.inc(outcome="expired")
+            return
+        cls_name = (
+            orphan.slo_class
+            if orphan.slo_class in self._class_names
+            else self._default_class.name
+        )
+        now = time.monotonic()
+        rec = _Record(
+            x=np.asarray(orphan.x, self._np_dtype), submit_t=now,
+            deadline=now + remaining, future=Future(),
+            trace_id=orphan.trace_id, slo_class=cls_name,
+        )
+        rec.replayed = True
+        # Re-accept under THIS incarnation's epoch so a second router
+        # death replays it again (the scan dedupes by trace id).
+        self._journal.accept(
+            rec.trace_id, rec.x, remaining, slo_class=cls_name
+        )
+        self._m_replays.inc(outcome="redispatched")
+        with self._lock:
+            self._counts["replayed"] += 1
+        with self._cond:
+            # Front of the queue: an orphan is the oldest work there is.
+            self._pending.appendleft(rec)
+            self._cond.notify()
+
     # -- dispatch -------------------------------------------------------------
+
+    def _rep_overloaded(self, rep: _Replica) -> bool:
+        """Load-aware pull: True while this replica's scraped queue depth
+        exceeds the least-loaded accepting replica's by more than
+        ``load_slack`` — it stops pulling and the work flows to the
+        lighter replicas instead. This is the cross-router coordination
+        point: N shared-nothing routers all read the same enriched
+        ``/healthz`` depth, so they all back off the same pile-up."""
+        if self._load_slack is None:
+            return False
+        d = rep.queue_depth
+        if d is None or d <= self._load_slack:
+            return False
+        with self._lock:
+            others = [
+                r.queue_depth for r in self._replicas.values()
+                if r is not rep and not r.removed and not r.draining
+                and r.healthy and r.queue_depth is not None
+            ]
+        return bool(others) and d > min(others) + self._load_slack
 
     def _dispatch_loop(self, rep: _Replica) -> None:
         while True:
@@ -537,6 +740,7 @@ class Router:
                     if (
                         self._pending
                         and rep.accepting(time.monotonic(), self._depth_limit)
+                        and not self._rep_overloaded(rep)
                     ):
                         rec = self._pending.popleft()
                         if (
@@ -581,6 +785,8 @@ class Router:
         if terminal_deadline:
             self._deliver_deadline(rec, "expired while queued at the router")
             return
+        if self._journal is not None:
+            self._journal.dispatch(rec.trace_id, rep.name, epoch)
         rep.inflight[rec.trace_id] = rec
         self._m_inflight.set(len(rep.inflight), replica=rep.name)
         remaining = rec.deadline - now
@@ -685,11 +891,16 @@ class Router:
 
     # -- terminal deliveries (each guarded: state=="done" exactly once) -------
 
+    def _journal_done(self, rec: _Record, outcome: str) -> None:
+        if self._journal is not None:
+            self._journal.done(rec.trace_id, outcome)
+
     def _complete(self, rec: _Record, epoch: int, logits, payload) -> None:
         with rec.lock:
             if rec.state != "inflight" or rec.epoch != epoch:
                 return  # a stale win: someone else owns this record now
             rec.state = "done"
+        self._journal_done(rec, "served")
         end = time.monotonic()
         with self._lock:
             self._counts["served"] += 1
@@ -712,6 +923,7 @@ class Router:
     def _deliver_deadline(self, rec: _Record, why: str) -> None:
         from mpi4dl_tpu.serve.engine import DeadlineExceededError
 
+        self._journal_done(rec, "rejected_deadline")
         with self._lock:
             self._counts["rejected_deadline"] += 1
         self._m_requests.inc(outcome="rejected_deadline")
@@ -719,6 +931,7 @@ class Router:
         rec.future.set_exception(DeadlineExceededError(why))
 
     def _deliver_failed(self, rec: _Record) -> None:
+        self._journal_done(rec, "failed")
         with self._lock:
             self._counts["failed"] += 1
         self._m_requests.inc(outcome="failed")
@@ -776,6 +989,8 @@ class Router:
                 "attempts": len(rec.history), "replicas": rec.history,
                 "e2e_latency_s": end - rec.submit_t,
                 "slo_class": rec.slo_class,
+                "router": self.name,
+                "replayed": rec.replayed,
             },
         ))
 

@@ -53,15 +53,29 @@ SUPERVISOR_METRICS = (
     "fleet_replicas",
     "fleet_replica_restarts_total",
     "fleet_recovery_seconds",
+    "fleet_routers",
+    "fleet_standby_replicas",
+    "fleet_promotions_total",
 )
 
 
 class _Slot:
-    """One replica slot (stable name across incarnations)."""
+    """One supervised slot (stable name across incarnations).
 
-    def __init__(self, name: str, index: int, breaker):
+    kind: ``replica`` (a worker engine) or ``router`` (a front-door
+        process, :mod:`mpi4dl_tpu.fleet.frontdoor`) — routers ride the
+        SAME state machine, backoff, breaker, and paging.
+    role: replicas only — ``serving`` (routed) or ``standby`` (warm
+        pool: fully warmed, ready handshake passed, but unrouted until
+        a promotion flips it in).
+    """
+
+    def __init__(self, name: str, index: int, breaker,
+                 kind: str = "replica", role: str = "serving"):
         self.name = name
         self.index = index
+        self.kind = kind
+        self.role = role
         self.proc: "ReplicaProcess | None" = None
         self.state = "new"
         self.breaker = breaker
@@ -95,6 +109,8 @@ class _Slot:
     def view(self) -> dict:
         return {
             "name": self.name,
+            "kind": self.kind,
+            "role": self.role,
             "state": self.state,
             "pid": self.pid,
             "attempt": self.attempt,
@@ -117,6 +133,25 @@ class FleetSupervisor:
     replicas: initial/static desired count (also the autoscale floor
         when ``federation`` is set, unless its config says otherwise).
     max_replicas: autoscale ceiling (static mode: a hard clamp).
+    routers: front-door router PROCESSES to run
+        (:mod:`mpi4dl_tpu.fleet.frontdoor`) — each gets a slot with the
+        same backoff + breaker + ``fleet_circuit_*`` paging a replica
+        slot gets; a respawned router recovers its predecessor's journal
+        (the router failure domain of the exactly-once story). Replica
+        membership is pushed to every running router over its
+        ``POST /replicas`` admin feed. 0 = no process routers (the
+        in-process ``router=`` keeps working either way).
+    router_args: extra argv for the router processes (image size,
+        queue bounds, SLO classes...). ``--name``/``--journal-dir`` are
+        appended per slot.
+    warm_pool: EXTRA replicas kept fully warmed (ready handshake — i.e.
+        ``assert_warm`` — passed) but UNROUTED, in the ``standby`` slot
+        state. A serving replica's death then promotes a standby
+        (health handshake + routing flip, sub-second) instead of paying
+        a cold spawn's warm-up compiles, and the pool is backfilled
+        asynchronously. A standby that dies (or fails the promotion
+        handshake) falls back to the cold-spawn path — promotion never
+        routes a corpse, and never routes the same worker twice.
     federation: a :class:`telemetry.SLOConfig` — runs a
         :class:`FederatedAggregator` over the replicas and follows its
         fleet-wide ``autoscale_desired_replicas`` gauge. None = static.
@@ -138,6 +173,9 @@ class FleetSupervisor:
         base_dir: "str | None" = None,
         replicas: int = 1,
         max_replicas: "int | None" = None,
+        routers: int = 0,
+        router_args: "list[str] | None" = None,
+        warm_pool: int = 0,
         federation=None,
         env: "dict | None" = None,
         reconcile_interval_s: float = 0.25,
@@ -165,7 +203,11 @@ class FleetSupervisor:
                   else telemetry.MetricsRegistry())
         )
         self._worker_cmd = worker_cmd(worker_args)
+        self._routers = int(routers)
+        self._router_args = list(router_args or ())
+        self._warm_pool = int(warm_pool)
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="mpi4dl-fleet-")
+        self._journal_dir = os.path.join(self.base_dir, "journals")
         self._env = dict(env if env is not None else os.environ)
         self._interval = float(reconcile_interval_s)
         self._hb_timeout = heartbeat_timeout_s
@@ -195,11 +237,20 @@ class FleetSupervisor:
             self.registry, "fleet_recovery_seconds"
         )
         self._m_alert = telemetry.declare(self.registry, "alert_active")
+        self._m_routers = telemetry.declare(self.registry, "fleet_routers")
+        self._m_standby = telemetry.declare(
+            self.registry, "fleet_standby_replicas"
+        )
+        self._m_promotions = telemetry.declare(
+            self.registry, "fleet_promotions_total"
+        )
 
         self._lock = threading.RLock()
         self._slots: "dict[str, _Slot]" = {}
         self.restarts = 0
         self.last_recovery_s: "float | None" = None
+        self.last_router_recovery_s: "float | None" = None
+        self.promotions = 0
 
         self.aggregator = None
         if federation is not None:
@@ -219,8 +270,13 @@ class FleetSupervisor:
 
     def start(self) -> None:
         with self._lock:
+            for i in range(self._routers):
+                self._ensure_slot(i, kind="router")
             for i in range(self._static_desired):
                 self._ensure_slot(i)
+            for i in range(self._static_desired,
+                           self._static_desired + self._warm_pool):
+                self._ensure_slot(i, role="standby")
         if self.aggregator is not None:
             self.aggregator.start()
         if self._thread is None or not self._thread.is_alive():
@@ -231,23 +287,66 @@ class FleetSupervisor:
             self._thread.start()
 
     def wait_ready(self, timeout_s: float = 600.0) -> None:
-        """Block until the fleet reaches the desired running count (the
-        CLI's before-load barrier)."""
+        """Block until the fleet reaches the desired running count —
+        serving replicas, the warm pool, AND the router set (the CLI's
+        before-load barrier)."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            if self.running_count() >= self.desired_replicas():
+            if (
+                self.running_count() >= self.desired_replicas()
+                and self.standby_count() >= self._warm_pool
+                and self.running_router_count() >= self._routers
+            ):
                 return
             time.sleep(0.1)
         raise TimeoutError(
             f"fleet not ready within {timeout_s:.0f}s: "
-            f"{self.running_count()}/{self.desired_replicas()} running"
+            f"{self.running_count()}/{self.desired_replicas()} serving, "
+            f"{self.standby_count()}/{self._warm_pool} standby, "
+            f"{self.running_router_count()}/{self._routers} routers"
         )
 
     def running_count(self) -> int:
         with self._lock:
             return sum(
-                1 for s in self._slots.values() if s.state == "running"
+                1 for s in self._slots.values()
+                if s.kind == "replica" and s.role == "serving"
+                and s.state == "running"
             )
+
+    def standby_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for s in self._slots.values()
+                if s.kind == "replica" and s.state == "standby"
+            )
+
+    def running_router_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for s in self._slots.values()
+                if s.kind == "router" and s.state == "running"
+            )
+
+    def router_submit_urls(self) -> "dict[str, str]":
+        """``{name: submit_url}`` of the running router processes — what
+        a :class:`~mpi4dl_tpu.fleet.frontdoor.RouterSetClient` fronts."""
+        with self._lock:
+            return {
+                s.name: f"http://127.0.0.1:{s.ports['predict_port']}"
+                for s in self._slots.values()
+                if s.kind == "router" and s.state == "running"
+                and s.ports is not None
+            }
+
+    def router_metrics_urls(self) -> "dict[str, str]":
+        with self._lock:
+            return {
+                s.name: f"http://127.0.0.1:{s.ports['metrics_port']}"
+                for s in self._slots.values()
+                if s.kind == "router" and s.state == "running"
+                and s.ports is not None and s.ports.get("metrics_port")
+            }
 
     def desired_replicas(self) -> int:
         """The reconcile target: the fleet-wide autoscale gauge when
@@ -265,7 +364,14 @@ class FleetSupervisor:
     def slot_by_index(self, index: int) -> "_Slot | None":
         with self._lock:
             for s in self._slots.values():
-                if s.index == index:
+                if s.kind == "replica" and s.index == index:
+                    return s
+        return None
+
+    def router_slot_by_index(self, index: int) -> "_Slot | None":
+        with self._lock:
+            for s in self._slots.values():
+                if s.kind == "router" and s.index == index:
                     return s
         return None
 
@@ -275,8 +381,12 @@ class FleetSupervisor:
         return {
             "desired": self.desired_replicas(),
             "running": self.running_count(),
+            "standby": self.standby_count(),
+            "routers": self.running_router_count(),
             "restarts": self.restarts,
+            "promotions": self.promotions,
             "last_recovery_s": self.last_recovery_s,
+            "last_router_recovery_s": self.last_router_recovery_s,
             "slots": slots,
         }
 
@@ -296,23 +406,33 @@ class FleetSupervisor:
 
     # -- slot lifecycle -------------------------------------------------------
 
-    def _ensure_slot(self, index: int) -> _Slot:
-        name = f"r{index}"
+    def _ensure_slot(self, index: int, kind: str = "replica",
+                     role: str = "serving") -> _Slot:
+        name = f"rt{index}" if kind == "router" else f"r{index}"
         slot = self._slots.get(name)
         if slot is None:
             slot = _Slot(name, index, elastic.RestartBreaker(
                 self._breaker_max, window_s=self._breaker_window_s,
                 clock=self._clock,
-            ))
+            ), kind=kind, role=role)
             self._slots[name] = slot
         if slot.state in ("new", "stopped"):
             self._spawn(slot)
         return slot
 
+    def _slot_cmd(self, slot: _Slot) -> "list[str]":
+        if slot.kind == "router":
+            from mpi4dl_tpu.fleet.frontdoor import router_cmd
+
+            return router_cmd(self._router_args) + [
+                "--name", slot.name, "--journal-dir", self._journal_dir,
+            ]
+        return self._worker_cmd
+
     def _spawn(self, slot: _Slot) -> None:
         hb = os.path.join(self.base_dir, f"{slot.name}.heartbeat")
         slot.proc = ReplicaProcess(
-            slot.name, self._worker_cmd, self.base_dir,
+            slot.name, self._slot_cmd(slot), self.base_dir,
             env=self._env, heartbeat_path=hb,
             log_path=os.path.join(self.base_dir, f"{slot.name}.log"),
         )
@@ -321,18 +441,163 @@ class FleetSupervisor:
         slot.ports = None
         slot.unhealthy_streak = 0
 
-    def _on_ready(self, slot: _Slot, ports: dict) -> None:
-        slot.ports = ports
-        slot.state = "running"
-        slot.attempt = 0
-        predict_url = f"http://127.0.0.1:{ports['predict_port']}"
-        metrics_url = f"http://127.0.0.1:{ports['metrics_port']}"
+    # -- membership: one replica set, every router ----------------------------
+
+    def _router_admins(self) -> "list":
+        from mpi4dl_tpu.fleet.frontdoor import RouterAdminClient
+
+        with self._lock:
+            return [
+                RouterAdminClient(
+                    s.name,
+                    f"http://127.0.0.1:{s.ports['predict_port']}",
+                )
+                for s in self._slots.values()
+                if s.kind == "router" and s.state == "running"
+                and s.ports is not None
+            ]
+
+    def _replica_urls(self, slot: _Slot) -> "tuple[str, str]":
+        return (
+            f"http://127.0.0.1:{slot.ports['predict_port']}",
+            f"http://127.0.0.1:{slot.ports['metrics_port']}",
+        )
+
+    def _register_replica(self, slot: _Slot) -> None:
+        """Route a ready serving replica: the in-process router, every
+        running router process, and the federation aggregator."""
+        predict_url, metrics_url = self._replica_urls(slot)
         if self.router is not None:
             self.router.add_replica(
                 slot.name, predict_url, health_url=metrics_url
             )
+        for admin in self._router_admins():
+            try:
+                admin.replica_op(
+                    "add", name=slot.name, predict_url=predict_url,
+                    health_url=metrics_url,
+                )
+            except Exception:  # noqa: BLE001 — a router mid-restart
+                pass  # re-learns the whole set at its ready handshake
         if self.aggregator is not None:
             self.aggregator.add_replica(slot.name, metrics_url)
+
+    def _deregister_replica(self, slot: _Slot, requeue: bool) -> None:
+        if self.router is not None:
+            self.router.remove_replica(slot.name, requeue=requeue)
+        for admin in self._router_admins():
+            try:
+                admin.replica_op("remove", name=slot.name, requeue=requeue)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.aggregator is not None:
+            self.aggregator.remove_replica(slot.name)
+
+    def _register_fleet_with_router(self, router_slot: _Slot) -> None:
+        """A (re)started router learns the current serving set — the
+        membership half of a successor's recovery (the journal half is
+        its own replay)."""
+        from mpi4dl_tpu.fleet.frontdoor import RouterAdminClient
+
+        admin = RouterAdminClient(
+            router_slot.name,
+            f"http://127.0.0.1:{router_slot.ports['predict_port']}",
+        )
+        with self._lock:
+            serving = [
+                s for s in self._slots.values()
+                if s.kind == "replica" and s.role == "serving"
+                and s.state == "running" and s.ports is not None
+            ]
+        for s in serving:
+            predict_url, metrics_url = self._replica_urls(s)
+            try:
+                admin.replica_op(
+                    "add", name=s.name, predict_url=predict_url,
+                    health_url=metrics_url,
+                )
+            except Exception:  # noqa: BLE001 — the next reconcile
+                pass  # re-registration catches it
+
+    # -- warm-pool promotion --------------------------------------------------
+
+    def _probe_promotable(self, slot: _Slot) -> bool:
+        """The promotion handshake: the standby must ANSWER healthy right
+        now — promotion never routes a corpse."""
+        import json
+        import urllib.request
+
+        if slot.proc is None or not slot.proc.alive():
+            return False
+        if slot.ports is None:
+            return False
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{slot.ports['metrics_port']}/healthz",
+                timeout=self._scrape_timeout_s,
+            ) as resp:
+                return bool(json.loads(resp.read().decode()).get("healthy"))
+        except Exception:  # noqa: BLE001 — any non-answer fails the
+            return False  # handshake; the caller falls back to cold spawn
+
+    def _promote_standby(self, victim: _Slot) -> bool:
+        """Replace a dead serving replica with a warmed standby: health
+        handshake, then routing flip. The victim slot inherits the
+        standby ROLE (its eventual respawn backfills the pool). Returns
+        False — cold-spawn fallback — when no standby passes the
+        handshake; a standby that failed it is killed and recycled
+        through the normal death path, never routed."""
+        with self._lock:
+            candidates = [
+                s for s in self._slots.values()
+                if s.kind == "replica" and s.role == "standby"
+                and s.state == "standby"
+            ]
+        for cand in sorted(candidates, key=lambda s: s.index):
+            if not self._probe_promotable(cand):
+                # Dead-during-promotion race: recycle it below (its own
+                # death will be seen by the next tick) and keep looking.
+                continue
+            with self._lock:
+                if cand.state != "standby":
+                    continue  # raced with its own death handling
+                cand.role = "serving"
+                cand.state = "running"
+                victim.role = "standby"
+            self._register_replica(cand)
+            self.promotions += 1
+            self._m_promotions.inc()
+            if victim.death_t is not None:
+                self.last_recovery_s = self._clock() - victim.death_t
+                self._m_recovery.set(self.last_recovery_s)
+                victim.death_t = None
+            return True
+        return False
+
+    def _on_ready(self, slot: _Slot, ports: dict) -> None:
+        slot.ports = ports
+        slot.attempt = 0
+        if slot.kind == "router":
+            slot.state = "running"
+            self._register_fleet_with_router(slot)
+            if self.aggregator is not None and ports.get("metrics_port"):
+                # The router's /snapshotz merges like any replica's.
+                self.aggregator.add_replica(
+                    slot.name,
+                    f"http://127.0.0.1:{ports['metrics_port']}",
+                )
+            if slot.death_t is not None:
+                self.last_router_recovery_s = self._clock() - slot.death_t
+                slot.death_t = None
+            return
+        if slot.role == "standby":
+            # Warm but unrouted: the ready handshake means assert_warm
+            # passed, so promotion later is a routing flip, not a spawn.
+            slot.state = "standby"
+            slot.death_t = None  # a pool backfill is not a recovery
+            return
+        slot.state = "running"
+        self._register_replica(slot)
         if slot.death_t is not None:
             # Death-to-replacement-serving: the fleet's recovery latency
             # (bench-trended via the fleet_2replica extra).
@@ -342,17 +607,22 @@ class FleetSupervisor:
 
     def _on_death(self, slot: _Slot, reason: str, kind: str) -> None:
         """A confirmed-dead incarnation: requeue its work, count it,
-        decide between backoff-respawn and tripping the breaker."""
+        promote a standby if one is warm, decide between backoff-respawn
+        and tripping the breaker."""
         now = self._clock()
         self.restarts += 1
         slot.last_reason = reason
         if slot.death_t is None:
             slot.death_t = now
-        if self.router is not None:
+        if slot.kind == "replica":
             # The process is gone (exited or just SIGKILLed): requeueing
             # its ledger cannot double-execute.
-            self.router.remove_replica(slot.name, requeue=True)
-        if self.aggregator is not None:
+            self._deregister_replica(slot, requeue=True)
+            if slot.role == "serving" and self._warm_pool:
+                self._promote_standby(slot)
+        elif self.aggregator is not None:
+            # Router death: its journal is its ledger — the successor
+            # replays it; nothing to requeue here.
             self.aggregator.remove_replica(slot.name)
         self._m_restarts.inc(replica=slot.name, reason=kind)
         slot.breaker.record_failure()
@@ -531,7 +801,9 @@ class FleetSupervisor:
         with self._lock:
             slots = list(self._slots.values())
         for slot in slots:
-            if slot.state == "running":
+            if slot.state in ("running", "standby"):
+                # Standby replicas get the same death/wedge/503 watch —
+                # a rotten pool must be replaced BEFORE it is needed.
                 self._check_running(slot, now)
             elif slot.state == "starting":
                 self._check_starting(slot, now)
@@ -569,6 +841,9 @@ class FleetSupervisor:
             slot.unhealthy_streak = 0
 
     def _check_starting(self, slot: _Slot, now: float) -> None:
+        del now  # the spawn age is measured on the process handle's own
+        # monotonic clock (spawned_age_s) — mixing an injected test clock
+        # with a real monotonic stamp would mis-measure the timeout
         ports = slot.proc.poll_ready()
         if ports is not None:
             self._on_ready(slot, ports)
@@ -577,36 +852,57 @@ class FleetSupervisor:
                 slot,
                 f"exited during start rc={slot.proc.returncode}", "exit",
             )
-        elif now - slot.proc.spawned_at > self._spawn_timeout_s:
+        elif slot.proc.spawned_age_s() > self._spawn_timeout_s:
             slot.proc.kill_hard()
             self._on_death(slot, "start timeout", "exit")
 
     def _reconcile_count(self) -> None:
         desired = self.desired_replicas()
         with self._lock:
-            active = [
+            serving = [
                 s for s in self._slots.values()
-                if s.state in ("starting", "running", "backoff", "draining")
+                if s.kind == "replica" and s.role == "serving"
+                and s.state in ("starting", "running", "backoff", "draining")
             ]
-            if len(active) < desired:
+            standby = [
+                s for s in self._slots.values()
+                if s.kind == "replica" and s.role == "standby"
+                and s.state in ("starting", "standby", "backoff")
+            ]
+            routers = [
+                s for s in self._slots.values()
+                if s.kind == "router"
+                and s.state in ("starting", "running", "backoff")
+            ]
+            replica_used = {
+                s.index for s in self._slots.values()
+                if s.kind == "replica"
+                and s.state in ("starting", "running", "standby",
+                                "backoff", "draining")
+            }
+            if len(serving) < desired:
                 # Fill the lowest free indexes (stable names).
-                used = {s.index for s in active}
                 i = 0
-                while len(active) < desired:
-                    if i not in used or self._slots.get(f"r{i}") is None \
-                            or self._slots[f"r{i}"].state in ("new", "stopped"):
+                while len(serving) < desired:
+                    slot = self._slots.get(f"r{i}")
+                    if i not in replica_used or (
+                        slot is not None
+                        and slot.state in ("new", "stopped")
+                    ):
+                        if slot is not None:
+                            slot.role = "serving"
                         slot = self._ensure_slot(i)
-                        if slot not in active:
-                            active.append(slot)
-                        used.add(i)
+                        if slot not in serving:
+                            serving.append(slot)
+                        replica_used.add(i)
                     i += 1
                     if i > self._max_replicas + len(self._slots):
                         break  # everything else is circuit_open
-            elif len(active) > desired:
+            elif len(serving) > desired:
                 # Scale down: drain the highest-index running replicas.
-                excess = len(active) - desired
+                excess = len(serving) - desired
                 victims = sorted(
-                    (s for s in active if s.state == "running"),
+                    (s for s in serving if s.state == "running"),
                     key=lambda s: -s.index,
                 )[:excess]
                 for slot in victims:
@@ -615,32 +911,84 @@ class FleetSupervisor:
                         target=self._drain_and_stop, args=(slot,),
                         name=f"mpi4dl-fleet-drain-{slot.name}", daemon=True,
                     ).start()
+            # Backfill the warm pool (a promotion consumed one, or a
+            # standby died and its slot went circuit_open): new standby
+            # slots take the lowest free replica indexes.
+            i = 0
+            while len(standby) < self._warm_pool:
+                slot = self._slots.get(f"r{i}")
+                if i not in replica_used or (
+                    slot is not None and slot.state in ("new", "stopped")
+                ):
+                    if slot is not None:
+                        slot.role = "standby"
+                    slot = self._ensure_slot(i, role="standby")
+                    standby.append(slot)
+                    replica_used.add(i)
+                i += 1
+                if i > self._max_replicas + self._warm_pool \
+                        + len(self._slots):
+                    break
+            # Router slots: static count, same respawn machinery.
+            if len(routers) < self._routers:
+                router_used = {
+                    s.index for s in self._slots.values()
+                    if s.kind == "router"
+                    and s.state in ("starting", "running", "backoff")
+                }
+                for i in range(self._routers):
+                    if len(routers) >= self._routers:
+                        break
+                    if i not in router_used:
+                        slot = self._slots.get(f"rt{i}")
+                        if slot is None or slot.state in ("new", "stopped"):
+                            routers.append(
+                                self._ensure_slot(i, kind="router")
+                            )
 
     def _drain_and_stop(self, slot: _Slot) -> None:
-        """Scale-down drain: stop admissions (router-side), flush the
-        in-flight ledger, SIGTERM (the worker drains its engine queue
+        """Scale-down drain: stop admissions (every router), flush the
+        in-flight ledgers, SIGTERM (the worker drains its engine queue
         and exits 0), then deregister."""
         if self.router is not None:
             self.router.drain_replica(
                 slot.name, timeout_s=self._drain_timeout_s
             )
+        for admin in self._router_admins():
+            try:
+                admin.replica_op(
+                    "drain", name=slot.name,
+                    timeout_s=self._drain_timeout_s,
+                )
+            except Exception:  # noqa: BLE001
+                pass
         if slot.proc is not None:
             slot.proc.terminate(wait_s=self._drain_timeout_s)
-        if self.router is not None:
-            # Ledger flushed (or timed out) and the process is gone;
-            # anything left requeues rather than hangs.
-            self.router.remove_replica(slot.name, requeue=True)
-        if self.aggregator is not None:
-            self.aggregator.remove_replica(slot.name)
+        # Ledgers flushed (or timed out) and the process is gone;
+        # anything left requeues rather than hangs.
+        self._deregister_replica(slot, requeue=True)
         slot.ports = None
         slot.state = "stopped"
 
     def _publish_gauges(self) -> None:
         with self._lock:
             by_state: "dict[str, int]" = {}
+            router_by_state: "dict[str, int]" = {}
+            standby = 0
             for s in self._slots.values():
+                if s.kind == "router":
+                    router_by_state[s.state] = (
+                        router_by_state.get(s.state, 0) + 1
+                    )
+                    continue
+                if s.state == "standby":
+                    standby += 1
                 by_state[s.state] = by_state.get(s.state, 0) + 1
         self._m_replicas.set(self.desired_replicas(), state="desired")
         for state in ("running", "starting", "backoff", "draining",
                       "circuit_open"):
             self._m_replicas.set(by_state.get(state, 0), state=state)
+        self._m_standby.set(standby)
+        self._m_routers.set(self._routers, state="desired")
+        for state in ("running", "starting", "backoff", "circuit_open"):
+            self._m_routers.set(router_by_state.get(state, 0), state=state)

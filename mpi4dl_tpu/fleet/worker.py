@@ -8,7 +8,14 @@ builds a synthetic calibrated model (the same zero-artifact path as
   the router's :class:`~mpi4dl_tpu.fleet.replica.ReplicaClient` is the
   other side). Engine admission failures map to structured HTTP errors:
   429 queue-full (with the engine's ``retry_after_s`` cadence hint),
-  504 deadline, 503 draining.
+  504 deadline, 503 draining. Idempotent by trace id
+  (:class:`_ServedCache`): a duplicate arrival — a client's failover
+  retry through a second router, or a successor router replaying a dead
+  router's journal — answers from the cached result (``"cached": true``)
+  or joins the in-flight future instead of executing twice.
+- ``POST /served`` — the dedupe probe: which of the posted trace ids
+  this replica served or has in flight (journal replay asks before
+  re-dispatching an orphan).
 - ``POST /chaos`` — the fault-injection surface
   (:mod:`mpi4dl_tpu.fleet.chaos`): ``wedge`` blocks the batcher's
   dispatch mid-loop (submit path and HTTP threads stay alive — the
@@ -148,6 +155,58 @@ class _DelayedRegistry:
         return getattr(self._registry, name)
 
 
+class _ServedCache:
+    """Replica-side idempotency registry, keyed by trace id.
+
+    The exactly-once guarantee across a ROUTER death needs the replica's
+    help: the same trace id can legitimately arrive twice — the client's
+    failover retry through a surviving router, and the dead router's
+    successor re-dispatching its journal orphans. This cache makes the
+    second arrival a read, not a second execution: completed requests
+    answer from the cached payload, concurrent duplicates join the
+    in-flight engine future. Bounded FIFO eviction; the window only has
+    to outlive the replay grace + client retry horizon, not history."""
+
+    def __init__(self, capacity: int = 4096):
+        import collections
+
+        self._done: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        self._inflight: "dict[str, object]" = {}
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()
+
+    def lookup(self, trace_id: str):
+        """(cached_payload, inflight_future) — at most one is non-None."""
+        with self._lock:
+            payload = self._done.get(trace_id)
+            if payload is not None:
+                return payload, None
+            return None, self._inflight.get(trace_id)
+
+    def begin(self, trace_id: str, future) -> None:
+        with self._lock:
+            self._inflight[trace_id] = future
+
+    def finish(self, trace_id: str, payload: "dict | None") -> None:
+        """Complete an in-flight entry; only SUCCESS payloads are cached
+        (queue-full/deadline outcomes stay retriable by design)."""
+        with self._lock:
+            self._inflight.pop(trace_id, None)
+            if payload is not None:
+                self._done[trace_id] = payload
+                while len(self._done) > self._capacity:
+                    self._done.popitem(last=False)
+
+    def served(self, trace_ids) -> "list[str]":
+        with self._lock:
+            return [
+                t for t in trace_ids
+                if t in self._done or t in self._inflight
+            ]
+
+
 def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                     port: int) -> ThreadingHTTPServer:
     from mpi4dl_tpu.serve.engine import (
@@ -155,6 +214,8 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
         DrainedError,
         QueueFullError,
     )
+
+    cache = _ServedCache()
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, status: int, payload: dict) -> None:
@@ -171,6 +232,11 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                 req = json.loads(self.rfile.read(length).decode())
                 if self.path == "/predict":
                     self._predict(req)
+                elif self.path == "/served":
+                    self._reply(200, {
+                        "ok": True,
+                        "served": cache.served(req.get("trace_ids", ())),
+                    })
                 elif self.path == "/chaos":
                     self._reply(200, chaos.apply(
                         req["action"], req.get("seconds", 0.0)
@@ -193,26 +259,43 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
             if draining.is_set():
                 self._reply(503, {"ok": False, "error": "draining"})
                 return
-            x = np.frombuffer(
-                base64.b64decode(req["x_b64"]), dtype=req.get(
-                    "dtype", "float32"
-                )
-            ).reshape(req["shape"])
-            try:
-                fut = engine.submit(
-                    x,
-                    deadline_s=req.get("deadline_s"),
-                    trace_id=req.get("trace_id"),
-                    slo_class=req.get("slo_class"),
-                )
-            except QueueFullError as e:
-                self._reply(429, {
-                    "ok": False, "error": "queue_full",
-                    "retry_after_s": e.retry_after_s,
-                    "slo_class": e.slo_class,
-                    "shed": e.shed,
-                })
-                return
+            # Idempotency by trace id: a duplicate of a COMPLETED request
+            # (client failover retry or a successor router's journal
+            # replay) answers from the cache; a duplicate of an IN-FLIGHT
+            # one joins the live engine future — this engine executes a
+            # given trace id at most once.
+            tid = req.get("trace_id")
+            joined = None
+            if tid:
+                payload, joined = cache.lookup(tid)
+                if payload is not None:
+                    self._reply(200, dict(payload, cached=True))
+                    return
+            if joined is not None:
+                fut = joined
+            else:
+                x = np.frombuffer(
+                    base64.b64decode(req["x_b64"]), dtype=req.get(
+                        "dtype", "float32"
+                    )
+                ).reshape(req["shape"])
+                try:
+                    fut = engine.submit(
+                        x,
+                        deadline_s=req.get("deadline_s"),
+                        trace_id=tid,
+                        slo_class=req.get("slo_class"),
+                    )
+                except QueueFullError as e:
+                    self._reply(429, {
+                        "ok": False, "error": "queue_full",
+                        "retry_after_s": e.retry_after_s,
+                        "slo_class": e.slo_class,
+                        "shed": e.shed,
+                    })
+                    return
+                if tid:
+                    cache.begin(tid, fut)
             try:
                 # The engine enforces the deadline; +5s grace means a
                 # late result still surfaces as the engine's own typed
@@ -221,26 +304,38 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                     timeout=(req.get("deadline_s") or 30.0) + 5.0
                 )
             except DeadlineExceededError as e:
+                if tid:
+                    cache.finish(tid, None)  # terminal but NOT cacheable
                 self._reply(504, {"ok": False, "error": f"deadline: {e}"})
                 return
             except DrainedError as e:
+                if tid:
+                    cache.finish(tid, None)
                 self._reply(503, {"ok": False, "error": f"drained: {e}"})
                 return
             except Exception as e:  # noqa: BLE001 — engine-side failure
+                if tid:
+                    cache.finish(tid, None)
                 self._reply(500, {
                     "ok": False, "error": f"{type(e).__name__}: {e}",
                 })
                 return
             logits = np.asarray(logits)
-            self._reply(200, {
+            payload = {
                 "ok": True,
                 "logits_b64": base64.b64encode(logits.tobytes()).decode(),
                 "dtype": str(logits.dtype),
                 "shape": list(logits.shape),
-                "trace_id": getattr(fut, "trace_id", req.get("trace_id")),
+                "trace_id": getattr(fut, "trace_id", tid),
                 "engine_e2e_s": getattr(fut, "e2e_latency_s", None),
                 "pid": os.getpid(),
-            })
+            }
+            if tid:
+                cache.finish(tid, payload)
+            self._reply(
+                200, dict(payload, cached=True) if joined is not None
+                else payload
+            )
 
         def log_message(self, *a):  # RPC traffic must not spam stderr
             pass

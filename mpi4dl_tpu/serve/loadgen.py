@@ -45,6 +45,7 @@ import time
 
 import numpy as np
 
+from mpi4dl_tpu.fleet.replica import FleetUnreachableError
 from mpi4dl_tpu.profiling import percentiles
 from mpi4dl_tpu.serve.engine import (
     DeadlineExceededError,
@@ -157,6 +158,7 @@ class _Tally:
         self.served = 0
         self.rejected_queue_full = 0
         self.queue_full_retries = 0
+        self.router_failovers = 0
         self.deadline_misses = 0
         self.errors = 0
         # Per-SLO-class outcome/latency split (class-mix runs): the
@@ -206,6 +208,14 @@ class _Tally:
         with self.lock:
             self.queue_full_retries += 1
 
+    def router_failover(self, n: int = 1) -> None:
+        """A connection-refused/reset on a front-door router the client
+        absorbed by retrying elsewhere (or later) — counted SEPARATELY
+        from queue pressure: failovers are a router-death signal, not a
+        capacity one."""
+        with self.lock:
+            self.router_failovers += int(n)
+
     def resolve(
         self,
         future,
@@ -233,6 +243,12 @@ class _Tally:
                     rec["errors"] += 1
         t_done = time.monotonic()
         self._count(outcome)
+        # A router-set future reports how many router failovers it
+        # absorbed in flight (RouterSetClient); plain engine futures
+        # don't carry the attribute.
+        failovers = getattr(future, "failovers", 0)
+        if failovers:
+            self.router_failover(failovers)
         engine_e2e = getattr(future, "e2e_latency_s", None)
         overhead = None
         if outcome == "served":
@@ -289,19 +305,22 @@ def _submit_with_retry(
     queue_full_retries: int, retry_backoff_s: "float | None",
     slo_class: "str | None" = None,
 ):
-    """Submit with opt-in bounded retry on queue-full. Each bounce waits
+    """Submit with opt-in bounded retry on queue-full — and on the
+    router-set client's typed all-routers-down signal. Each bounce waits
     the engine's ``retry_after_s`` cadence hint (or the explicit
     ``retry_backoff_s``) doubled per attempt — open-loop overload then
     measures shed-AND-retry behavior (what a real client with a retry
-    policy experiences) instead of counting instant failures. Returns
-    the future, or None when the bounces exhausted the budget (tallied
-    as a terminal rejection)."""
+    policy experiences) instead of counting instant failures.
+    Connection-refused rides the SAME backoff budget but is counted as
+    ``router_failovers`` (a death signal), never as queue pressure.
+    Returns the future, or None when the bounces exhausted the budget
+    (tallied as a terminal rejection)."""
     attempts = 0
     kw = {"slo_class": slo_class} if slo_class is not None else {}
     while True:
         try:
             return engine.submit(x, deadline_s=deadline_s, trace_id=tid, **kw)
-        except QueueFullError as e:
+        except (QueueFullError, FleetUnreachableError) as e:
             if attempts >= queue_full_retries:
                 tally.reject(slo_class)
                 return None
@@ -309,7 +328,10 @@ def _submit_with_retry(
                 retry_backoff_s if retry_backoff_s is not None
                 else (e.retry_after_s or 0.01)
             )
-            tally.retried()
+            if isinstance(e, FleetUnreachableError):
+                tally.router_failover()
+            else:
+                tally.retried()
             time.sleep(min(base * (2.0 ** attempts), 1.0))
             attempts += 1
 
@@ -485,6 +507,7 @@ def _report(mode, offered, dt, tally: _Tally, engine, **extra) -> dict:
         "served": tally.served,
         "rejected_queue_full": tally.rejected_queue_full,
         "queue_full_retries": tally.queue_full_retries,
+        "router_failovers": tally.router_failovers,
         "deadline_misses": tally.deadline_misses,
         "errors": tally.errors,
         "duration_s": dt,

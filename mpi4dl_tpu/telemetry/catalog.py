@@ -207,9 +207,11 @@ CATALOG: "dict[str, MetricSpec]" = {
     # -- fleet (mpi4dl_tpu/fleet/: router.py, supervisor.py) -----------------
     "fleet_requests_total": MetricSpec(
         "counter", ("outcome",),
-        "Router-terminal request outcomes: served, failed (retry budget "
-        "spent), rejected_queue_full (router admission), "
-        "rejected_deadline, drained (router stopped).",
+        "Router-terminal request outcomes: served, served_cached (a "
+        "failover retry answered from a replica's idempotency cache — "
+        "never re-executed), failed (retry budget spent), "
+        "rejected_queue_full (router admission), rejected_deadline, "
+        "drained (router stopped).",
     ),
     "fleet_requeues_total": MetricSpec(
         "counter", ("reason",),
@@ -250,6 +252,34 @@ CATALOG: "dict[str, MetricSpec]" = {
         "(submit -> future resolved, requeues included); buckets carry "
         "exemplar trace ids, so the fleet p99 bucket names a real "
         "request.",
+    ),
+    "fleet_routers": MetricSpec(
+        "gauge", ("state",),
+        "Front-door router processes by state: desired, running, "
+        "starting, backoff, circuit_open (supervisor view; each router "
+        "slot rides the same backoff + breaker + paging as a replica "
+        "slot).",
+    ),
+    "fleet_router_journal_replays_total": MetricSpec(
+        "counter", ("outcome",),
+        "Orphaned journal entries a successor router processed after a "
+        "router death, by outcome: deduped (a replica had already "
+        "served/held the trace id — completed without re-execution), "
+        "redispatched (re-dispatched with a fresh epoch), expired "
+        "(deadline passed while orphaned).",
+    ),
+    "fleet_standby_replicas": MetricSpec(
+        "gauge", (),
+        "Warm-pool replicas fully warmed (ready handshake / assert_warm "
+        "passed) but unrouted, standing by for promotion; the "
+        "supervisor backfills toward the warm_pool target.",
+    ),
+    "fleet_promotions_total": MetricSpec(
+        "counter", (),
+        "Standby-to-serving promotions after a replica death: a health "
+        "handshake + routing flip replaced a cold spawn, which is what "
+        "cuts fleet_recovery_seconds from warm-up-compile time to "
+        "sub-second.",
     ),
     "fleet_replica_skew": MetricSpec(
         "gauge", ("replica",),

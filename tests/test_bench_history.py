@@ -107,6 +107,53 @@ def test_fleet_recovery_series_trended_and_inverted(tmp_path):
     assert by_key["fleet_2replica.recovery_s"]["verdict"] == "regressed"
 
 
+def test_fleet_recovery_by_domain_trended_and_inverted(tmp_path):
+    """ISSUE CI satellite (HA front door): the fleet extra now records
+    one recovery latency PER FAILURE DOMAIN ({"replica": ..., "router":
+    ...} — warm-pool promotion vs router journal recovery); both become
+    trend series with the regression sign inverted."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    r = _result(7.0, 0.5)
+    r["extras"]["fleet_2replica"] = {
+        "value": 350.0, "requeued": 4,
+        "recovery_s": {"replica": 0.4, "router": 1.1},
+        "journal_replays": {"deduped": 3, "redispatched": 1},
+    }
+    s = extract_series(r)
+    assert s["fleet_2replica.recovery_s.replica"] == 0.4
+    assert s["fleet_2replica.recovery_s.router"] == 1.1
+    assert lower_is_better("fleet_2replica.recovery_s.replica")
+    assert lower_is_better("fleet_2replica.recovery_s.router")
+    # A None (unmeasured) domain contributes nothing rather than 0.0.
+    r["extras"]["fleet_2replica"]["recovery_s"] = {
+        "replica": 0.4, "router": None,
+    }
+    s = extract_series(r)
+    assert "fleet_2replica.recovery_s.router" not in s
+    # Regression drill: promotion recovery slipping back toward
+    # cold-spawn time is CI-visible.
+    fast, slow = _result(7.0, 0.5), _result(7.0, 0.5)
+    fast["extras"]["fleet_2replica"] = {
+        "value": 350.0, "recovery_s": {"replica": 0.4, "router": 1.0},
+    }
+    slow["extras"]["fleet_2replica"] = {
+        "value": 350.0, "recovery_s": {"replica": 6.8, "router": 1.0},
+    }
+    paths = _write_rounds(tmp_path, [_round(1, 0, fast),
+                                     _round(2, 0, slow)])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [fast, slow]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["fleet_2replica.recovery_s.replica"]["verdict"] \
+        == "regressed"
+    assert by_key["fleet_2replica.recovery_s.router"]["verdict"] == "flat"
+
+
 def test_tail_ratio_trended_and_inverted(tmp_path):
     """ISSUE 10 CI satellite: the serving extra's tail summary
     (p99/p50 ratio) becomes a trend series with the regression sign

@@ -218,6 +218,23 @@ def test_fleet_cli_plan_smoke(capsys):
     assert main(["--replicas", "2", "--chaos", "explode:1", "--plan"]) == 2
     assert main(["--replicas", "2", "--chaos", "kill:5", "--plan"]) == 2
 
+    # ISSUE 12: the HA front door joins the plan — router count, warm
+    # pool, router_cmd, and the kill:router chaos domain, with
+    # out-of-set router targets as loud usage errors.
+    rc = main(["--replicas", "2", "--routers", "2", "--warm-pool", "1",
+               "--chaos", "kill:router:1@2", "--plan"])
+    assert rc == 0
+    plan = json.loads(capsys.readouterr().out)
+    assert plan["routers"] == 2 and plan["warm_pool"] == 1
+    assert plan["chaos"] == ["kill:router1@+2s"]
+    assert "mpi4dl_tpu.fleet.frontdoor" in " ".join(plan["router_cmd"])
+    assert main(["--replicas", "2", "--routers", "2",
+                 "--chaos", "kill:router:2", "--plan"]) == 2
+    # A warm-pool slot is a legitimate replica kill target.
+    assert main(["--replicas", "2", "--warm-pool", "1",
+                 "--chaos", "kill:2", "--plan"]) == 0
+    capsys.readouterr()
+
 
 def test_analyze_memory_plan_cli(tmp_path, capsys):
     """ISSUE CI satellite: `python -m mpi4dl_tpu.analyze memory-plan`

@@ -60,6 +60,270 @@ def test_chaos_spec_errors():
             parse_chaos_spec(bad)
 
 
+def test_chaos_spec_router_goldens():
+    """ISSUE 12: the router failure domain joins the spec grammar —
+    ``kill:router[:N]`` targets a front-door router process."""
+    op = parse_chaos_spec("kill:router")
+    assert (op.action, op.domain, op.target) == ("kill", "router", 0)
+    assert op.describe() == "kill:router0@+1s"
+    op = parse_chaos_spec("kill:router:1@2.5")
+    assert (op.action, op.domain, op.target, op.at_s) == (
+        "kill", "router", 1, 2.5
+    )
+    assert op.describe() == "kill:router1@+2.5s"
+    # Replica specs are untouched (domain defaults to replica).
+    assert parse_chaos_spec("kill:1").domain == "replica"
+    # Routers have no /chaos surface: soft faults on them are usage
+    # errors, not silent no-ops.
+    for bad in ("wedge:router", "delay:router:1=3", "blackhole:router"):
+        with pytest.raises(ValueError, match="router"):
+            parse_chaos_spec(bad)
+
+
+# -- router recovery journal (ISSUE 12 tentpole) ------------------------------
+
+
+def test_journal_write_scan_goldens(tmp_path):
+    """Accept/done lifecycle: a completed request is NOT an orphan (the
+    stale-entry no-op), an accepted-only one is, an expired one is
+    dropped as expired, and the payload round-trips bit-exact."""
+    from mpi4dl_tpu.fleet.journal import RouterJournal, scan
+
+    path = str(tmp_path / "rt0.journal.jsonl")
+    j = RouterJournal(path)
+    x = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    j.accept("t-done", x, 30.0, slo_class="tight")
+    j.dispatch("t-done", "r0", 1)
+    j.done("t-done", "served")
+    j.accept("t-orphan", x * 2, 30.0, slo_class=None)
+    j.dispatch("t-orphan", "r1", 1)
+    j.accept("t-expired", x, 0.0)  # deadline already passed at scan
+    j.close()
+
+    s = scan(path)
+    assert s.completed == 1
+    assert s.expired == 1
+    assert [o.trace_id for o in s.orphans] == ["t-orphan"]
+    orphan = s.orphans[0]
+    np.testing.assert_array_equal(orphan.x, x * 2)  # payload round-trip
+    assert orphan.remaining_s() > 25
+    assert s.last_epoch == 1
+
+
+def test_journal_epoch_fencing_across_incarnations(tmp_path):
+    """The cross-restart fence: incarnation 2 re-accepts incarnation 1's
+    orphan and completes it — incarnation 3's scan sees NO orphan (a
+    done in any epoch completes the trace id), and a stale journal
+    entry for the completed request is a no-op."""
+    from mpi4dl_tpu.fleet.journal import RouterJournal, scan
+
+    path = str(tmp_path / "rt0.journal.jsonl")
+    x = np.zeros((2, 2, 3), np.float32)
+    j1 = RouterJournal(path)
+    assert j1.router_epoch == 1
+    j1.accept("t-1", x, 60.0)
+    j1.close()  # died with t-1 stranded
+
+    j2 = RouterJournal(path)
+    assert j2.router_epoch == 2
+    assert [o.trace_id for o in j2.recovered.orphans] == ["t-1"]
+    assert j2.recovered.orphans[0].router_epoch == 1
+    j2.accept("t-1", x, 55.0)  # the replayed re-accept
+    j2.done("t-1", "served")
+    j2.close()
+
+    j3 = RouterJournal(path)
+    assert j3.router_epoch == 3
+    assert j3.recovered.orphans == []
+    assert j3.recovered.completed == 1
+    j3.close()
+
+
+def test_journal_scan_tolerates_torn_tail_and_missing_file(tmp_path):
+    """A SIGKILL mid-append leaves a torn final line; the scanner skips
+    it and keeps everything before it. A missing file is an empty scan,
+    not an error."""
+    from mpi4dl_tpu.fleet.journal import RouterJournal, scan
+
+    assert scan(str(tmp_path / "nope.jsonl")).orphans == []
+    path = str(tmp_path / "rt0.journal.jsonl")
+    j = RouterJournal(path)
+    j.accept("t-1", np.zeros((2, 2, 3), np.float32), 60.0)
+    j.close()
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "done", "trace_id": "t-1"')  # torn mid-write
+    s = scan(path)
+    assert s.skipped_lines == 1
+    assert [o.trace_id for o in s.orphans] == ["t-1"]  # the torn done
+    # never became durable — the request is still an orphan
+
+
+def test_router_replay_dedupes_redispatches_and_expires(tmp_path):
+    """A successor router over a predecessor's journal: an orphan a
+    replica already SERVED completes as a dedupe no-op (never
+    re-executed), a true orphan re-dispatches and serves, and the
+    replay counter splits by outcome."""
+    from mpi4dl_tpu.fleet.journal import RouterJournal, scan
+
+    path = str(tmp_path / "rt0.journal.jsonl")
+    x = np.zeros((2, 2, 3), np.float32)
+    j = RouterJournal(path)
+    j.accept("t-already-served", x, 60.0)
+    j.accept("t-orphan", x, 60.0)
+    j.accept("t-completed", x, 60.0)
+    j.done("t-completed", "served")   # stale entry: must be a no-op
+    j.close()
+
+    fake = _FakeReplica()
+    fake.served_trace_ids.append("t-already-served")
+    router = _mk_router(journal_path=path, replay_grace_s=0.6)
+    try:
+        router.add_replica("r0", fake.url, health_url=fake.url)
+        assert router.replay_journal() == 2  # completed one not parked
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if router.stats()["replayed"] == 2:
+                break
+            time.sleep(0.05)
+        m = router.registry.get("fleet_router_journal_replays_total")
+        assert m.value(outcome="deduped") == 1
+        assert m.value(outcome="redispatched") == 1
+        # The deduped orphan was NEVER re-executed on the replica; the
+        # true orphan was executed exactly once.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if "t-orphan" in fake.served_trace_ids:
+                break
+            time.sleep(0.05)
+        assert fake.served_trace_ids.count("t-already-served") == 1
+        assert fake.served_trace_ids.count("t-orphan") == 1
+    finally:
+        router.stop(drain=False)
+        fake.close()
+    # The journal is clean for the NEXT incarnation: everything done.
+    s = scan(path)
+    assert s.orphans == [] and s.completed == 3
+
+
+# -- the HA front door client (ISSUE 12 satellite) ----------------------------
+
+
+def _mk_router_server(fakes, **kw):
+    """One Router + HTTP surface over the given fake replicas."""
+    from mpi4dl_tpu.fleet.frontdoor import RouterServer
+
+    router = _mk_router(**kw)
+    for i, f in enumerate(fakes):
+        router.add_replica(f"r{i}", f.url, health_url=f.url)
+    return RouterServer(router, metrics_port=None)
+
+
+def test_router_set_client_fails_over_on_router_death():
+    """Two router processes' worth of /submit surface over one replica
+    set; killing one mid-run: every future still resolves with a
+    result, the failovers are counted per-request (future.failovers)
+    and in the loadgen report (router_failovers), and the survivors
+    carry the load."""
+    from mpi4dl_tpu.fleet.frontdoor import RouterSetClient
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    fake = _FakeReplica()
+    servers = [_mk_router_server([fake]) for _ in range(2)]
+    client = RouterSetClient(
+        {f"rt{i}": f"http://127.0.0.1:{s.port}"
+         for i, s in enumerate(servers)},
+        example_shape=(2, 2, 3), default_deadline_s=30.0,
+        backoff_base_s=0.01, backoff_max_s=0.05, down_s=0.2,
+    )
+    try:
+        rep = run_closed_loop(client, 24, concurrency=4, deadline_s=30.0)
+        assert rep["served"] == 24 and rep["errors"] == 0
+        assert rep["router_failovers"] == 0
+
+        servers[1].close()  # kill -9 equivalent: connection refused
+        rep = run_closed_loop(client, 24, concurrency=4, deadline_s=30.0)
+        assert rep["served"] == 24 and rep["errors"] == 0
+        assert rep["router_failovers"] >= 1  # the dead router was hit
+        assert client.stats()["router_failovers"] >= 1
+    finally:
+        client.close()
+        servers[0].close()
+        fake.close()
+
+
+def test_router_set_client_all_down_is_typed_and_loadgen_retries():
+    """Every router down: submit raises the typed, retriable
+    FleetUnreachableError with a retry hint — and the loadgen retry
+    loop treats it as retriable (counted as router_failovers, not
+    queue pressure), succeeding once a router is back."""
+    from mpi4dl_tpu.fleet.frontdoor import RouterSetClient
+    from mpi4dl_tpu.fleet.replica import FleetUnreachableError
+    from mpi4dl_tpu.serve.loadgen import _submit_with_retry, _Tally
+
+    fake = _FakeReplica()
+    server = _mk_router_server([fake])
+    url = f"http://127.0.0.1:{server.port}"
+    server.close()
+    client = RouterSetClient(
+        {"rt0": url}, example_shape=(2, 2, 3),
+        backoff_base_s=0.01, backoff_max_s=0.05, down_s=10.0,
+    )
+    try:
+        # First submit eats the connection-refused in its worker thread
+        # and marks the only router down...
+        fut = client.submit(np.zeros((2, 2, 3), np.float32),
+                            deadline_s=0.3)
+        with pytest.raises(Exception):
+            fut.result(timeout=10)
+        assert fut.failovers >= 1
+        # ...so the next admission fails FAST and TYPED.
+        with pytest.raises(FleetUnreachableError) as ei:
+            client.submit(np.zeros((2, 2, 3), np.float32))
+        assert ei.value.retry_after_s is not None
+
+        # Loadgen treats it as retriable with the hint-honoring backoff:
+        tally = _Tally()
+        out = _submit_with_retry(
+            client, np.zeros((2, 2, 3), np.float32), 0.3, "t-x",
+            tally, queue_full_retries=2, retry_backoff_s=0.01,
+        )
+        assert out is None  # budget spent while all routers stay down
+        assert tally.router_failovers >= 1
+        assert tally.queue_full_retries == 0  # NOT counted as pressure
+    finally:
+        client.close()
+        fake.close()
+
+
+def test_worker_served_cache_semantics():
+    """The replica-side idempotency registry: done answers dedupe,
+    in-flight duplicates join the live future, only successes are
+    cached, and the capacity bound evicts FIFO."""
+    from concurrent.futures import Future
+
+    from mpi4dl_tpu.fleet.worker import _ServedCache
+
+    c = _ServedCache(capacity=2)
+    fut = Future()
+    c.begin("t-1", fut)
+    payload, joined = c.lookup("t-1")
+    assert payload is None and joined is fut  # join, don't re-execute
+    assert c.served(["t-1", "t-2"]) == ["t-1"]  # in-flight counts
+    c.finish("t-1", {"ok": True, "n": 1})
+    payload, joined = c.lookup("t-1")
+    assert payload == {"ok": True, "n": 1} and joined is None
+    # Error outcomes are terminal for the RPC but NOT cached (a retry
+    # with fresh budget may succeed).
+    c.begin("t-2", Future())
+    c.finish("t-2", None)
+    assert c.lookup("t-2") == (None, None)
+    # FIFO eviction at capacity.
+    c.finish("t-3", {"n": 3})
+    c.finish("t-4", {"n": 4})
+    assert c.lookup("t-1") == (None, None)  # evicted
+    assert c.lookup("t-4")[0] == {"n": 4}
+
+
 # -- fake replicas: the router's unit-test doubles ----------------------------
 
 
@@ -90,6 +354,12 @@ class _FakeReplica:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length).decode())
+                if self.path == "/served":
+                    self._reply(200, {"served": [
+                        t for t in req.get("trace_ids", ())
+                        if t in fake.served_trace_ids
+                    ]})
+                    return
                 if fake.mode == "queue_full_once":
                     fake.mode = "ok"
                     self._reply(429, {
@@ -490,6 +760,178 @@ def test_breaker_evidence_degrades_without_log_or_telemetry(tmp_path):
         sup.close()
 
 
+# -- warm-pool standby + promotion (ISSUE 12 tentpole) ------------------------
+
+#: A no-JAX worker stand-in that honors the ready handshake AND answers
+#: /healthz 200 — the handshake surface standby promotion verifies.
+_HEALTHY_STUB = """
+    import json, os, sys, threading, time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({"healthy": True, "queue_depth": 0}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    ready = sys.argv[sys.argv.index("--ready-file") + 1]
+    port = httpd.server_address[1]
+    tmp = ready + ".tmp"
+    json.dump({"pid": os.getpid(), "predict_port": port,
+               "metrics_port": port}, open(tmp, "w"))
+    os.replace(tmp, ready)
+    time.sleep(3600)
+"""
+
+
+def test_warm_pool_promotion_replaces_dead_replica_fast(tmp_path):
+    """A serving replica dies with a warm standby up: recovery is a
+    PROMOTION — handshake + routing flip, no spawn in the recovery
+    path — so fleet_recovery_seconds is sub-spawn; the victim slot
+    backfills the pool asynchronously."""
+    cmd = _stub_worker(tmp_path, _HEALTHY_STUB)
+    router = _mk_router()
+    sup = _mk_supervisor(tmp_path, cmd, replicas=1, router=router,
+                         warm_pool=1)
+    try:
+        sup.start()
+        sup.wait_ready(timeout_s=30)
+        assert sup.standby_count() == 1
+        assert sup.registry.get("fleet_standby_replicas").value() == 1
+        # Only the serving replica is routed; the standby is warm but
+        # invisible to dispatch.
+        assert set(router._replicas) == {"r0"}
+        victim_pid = sup.slot_by_index(0).pid
+
+        os.kill(victim_pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sup.promotions == 1 and sup.running_count() == 1:
+                break
+            time.sleep(0.05)
+        assert sup.promotions == 1
+        assert sup.registry.get("fleet_promotions_total").value() == 1
+        # The routing flip happened: r1 (the ex-standby) serves, r0 is
+        # out — and recovery was promotion-fast, not spawn-bound.
+        assert "r1" in router._replicas and "r0" not in router._replicas
+        serving = [s for s in sup.state()["slots"]
+                   if s["kind"] == "replica" and s["role"] == "serving"]
+        assert [s["name"] for s in serving] == ["r1"]
+        assert sup.last_recovery_s is not None
+        assert sup.last_recovery_s < 5.0  # flip + handshake, not a spawn
+        # The pool backfills: the victim slot respawns INTO standby.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sup.standby_count() == 1:
+                break
+            time.sleep(0.05)
+        assert sup.standby_count() == 1
+        slot0 = sup.slot_by_index(0)
+        assert slot0.role == "standby" and slot0.state == "standby"
+        assert set(router._replicas) == {"r1"}  # still exactly one route
+    finally:
+        sup.close()
+        router.stop(drain=False)
+
+
+def test_promotion_race_dead_standby_falls_back_to_cold_spawn(tmp_path):
+    """ISSUE satellite: death DURING promotion — the standby is killed
+    right before the serving replica, so the promotion handshake meets
+    a corpse. The supervisor must fall back to the cold-spawn path and
+    NEVER route the dead standby (no double-route, no phantom
+    replica)."""
+    cmd = _stub_worker(tmp_path, _HEALTHY_STUB)
+    router = _mk_router()
+    sup = _mk_supervisor(tmp_path, cmd, replicas=1, router=router,
+                         warm_pool=1)
+    try:
+        sup.start()
+        sup.wait_ready(timeout_s=30)
+        standby_pid = sup.slot_by_index(1).pid
+        serving_pid = sup.slot_by_index(0).pid
+        # Kill the standby FIRST (no tick between: the serving death's
+        # promotion attempt races the standby's own death handling).
+        os.kill(standby_pid, signal.SIGKILL)
+        os.kill(serving_pid, signal.SIGKILL)
+        dead = {standby_pid, serving_pid}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            # Recovery means BOTH deaths were processed and replaced
+            # with fresh pids — the state gauges alone read green for an
+            # instant after the kills, before detection.
+            if (
+                sup.restarts >= 2
+                and sup.running_count() == 1
+                and sup.standby_count() == 1
+                and not ({sup.slot_by_index(0).pid,
+                          sup.slot_by_index(1).pid} & dead)
+            ):
+                break
+            time.sleep(0.05)
+        assert sup.running_count() == 1, sup.state()
+        assert sup.standby_count() == 1, sup.state()
+        assert not ({sup.slot_by_index(0).pid,
+                     sup.slot_by_index(1).pid} & dead)
+        # No promotion happened: the handshake refused the corpse and
+        # recovery went through a cold spawn instead.
+        assert sup.promotions == 0
+        # Exactly ONE route, and it points at a live process.
+        assert len(router._replicas) == 1
+        serving = [s for s in sup.state()["slots"]
+                   if s["kind"] == "replica" and s["role"] == "serving"]
+        assert len(serving) == 1
+        assert set(router._replicas) == {serving[0]["name"]}
+        assert sup._slots[serving[0]["name"]].proc.alive()
+    finally:
+        sup.close()
+        router.stop(drain=False)
+
+
+def test_heartbeat_staleness_immune_to_wall_clock_step(tmp_path):
+    """ISSUE satellite (monotonic audit): staleness is measured from the
+    last observed mtime CHANGE on OUR monotonic clock — a wall-clock
+    step (NTP jump, VM resume) that rewrites mtimes into the past must
+    NOT mass-expire heartbeats and kill a healthy fleet."""
+    from mpi4dl_tpu.fleet.replica import ReplicaProcess
+
+    hb = str(tmp_path / "r0.heartbeat")
+    p = ReplicaProcess("r0", ["true"], str(tmp_path), heartbeat_path=hb)
+    p._hb_seen = time.monotonic() - 100.0  # long-stale baseline
+    p._hb_mtime = None
+    elastic.touch(hb)
+    assert p.heartbeat_stale_s() < 1.0  # a beat arrived: fresh
+
+    # The wall clock steps BACK one hour mid-run: the file's mtime now
+    # reads an hour old. Change-detection treats it as a beat (the
+    # mtime CHANGED); comparing mtime to time.time() would declare 1h
+    # of staleness and SIGKILL a healthy replica.
+    past = time.time() - 3600.0
+    os.utime(hb, (past, past))
+    assert p.heartbeat_stale_s() < 1.0
+    # And with NO further beats, staleness grows on the monotonic clock.
+    p._hb_seen = time.monotonic() - 7.5
+    p._hb_mtime = os.path.getmtime(hb)
+    assert 7.0 < p.heartbeat_stale_s() < 9.0
+
+
+def test_spawn_age_uses_process_monotonic_clock(tmp_path):
+    """The spawn-timeout input comes from the process handle's own
+    monotonic clock (spawned_age_s), never `injected_clock() - stamp`
+    arithmetic across two different clocks."""
+    from mpi4dl_tpu.fleet.replica import ReplicaProcess
+
+    p = ReplicaProcess("r0", ["true"], str(tmp_path))
+    assert p.spawned_age_s() == 0.0  # never spawned
+    p.spawned_at = time.monotonic() - 3.0
+    assert 2.5 < p.spawned_age_s() < 4.0
+
+
 # -- elastic satellites -------------------------------------------------------
 
 
@@ -723,6 +1165,142 @@ def _drill_events(tele_dir) -> "list[dict]":
                 telemetry.read_events(os.path.join(tele_dir, str(f)))
             )
     return events
+
+
+def test_fleet_ha_drill_kill_router_mid_flight(tmp_path):
+    """ISSUE 12 acceptance: 2 front-door router processes × 2 real
+    replicas under closed-loop load, ``kill -9`` one ROUTER mid-flight.
+    Every future resolves with a result (the client fails over —
+    router_failovers > 0), the supervisor respawns the router slot, the
+    successor replays its predecessor's journal
+    (fleet_router_journal_replays_total > 0 on its /metrics), and no
+    trace id is served twice across all engine logs."""
+    import urllib.request
+
+    from mpi4dl_tpu.fleet.frontdoor import RouterSetClient
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    tele = str(tmp_path / "tele")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    sup = FleetSupervisor(
+        ["--image-size", "16", "--max-batch", "2",
+         "--telemetry-dir", tele],
+        router=None,
+        routers=2,
+        router_args=["--image-size", "16", "--max-attempts", "4",
+                     "--inflight-per-replica", "4",
+                     "--health-interval", "0.1",
+                     "--replay-grace", "1.0",
+                     "--telemetry-dir", tele],
+        replicas=2, max_replicas=2,
+        env=env,
+        base_dir=str(tmp_path / "fleet"),
+        reconcile_interval_s=0.1,
+        heartbeat_timeout_s=5.0,
+        backoff_base_s=0.1, backoff_max_s=0.5,
+        spawn_timeout_s=420.0,
+    )
+    n_requests = 300
+    client = None
+    try:
+        sup.start()
+        sup.wait_ready(timeout_s=420)
+        client = RouterSetClient(
+            sup.router_submit_urls(), example_shape=(16, 16, 3),
+            default_deadline_s=120.0, telemetry_dir=tele,
+            down_s=0.3, backoff_base_s=0.02, backoff_max_s=0.2,
+        )
+        report = {}
+
+        def load():
+            report.update(run_closed_loop(
+                client, n_requests, concurrency=8, deadline_s=120.0,
+                events=client.events,
+            ))
+
+        t = threading.Thread(target=load)
+        t.start()
+        # Mid-flight: wait for real traffic THROUGH the victim router,
+        # then SIGKILL it while requests sit in its queue + RPCs.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            s = client.stats()
+            if s["per_router"].get("rt1", {}).get("dispatches", 0) >= 20:
+                break
+            time.sleep(0.01)
+        victim = sup.router_slot_by_index(1)
+        victim_pid = victim.pid
+        os.kill(victim_pid, signal.SIGKILL)
+        t.join(timeout=300)
+        assert not t.is_alive(), "load run wedged"
+
+        # Zero accepted-request loss through a ROUTER death: every
+        # future resolved with a RESULT, absorbed by client failover.
+        assert report["served"] == n_requests, report
+        assert report["errors"] == 0 and report["deadline_misses"] == 0
+        assert report["router_failovers"] >= 1, report
+
+        # The supervisor restores the router set; the successor is a
+        # fresh pid on the same slot (same name, same journal).
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            if (
+                sup.running_router_count() == 2
+                and sup.router_slot_by_index(1).pid != victim_pid
+            ):
+                break
+            time.sleep(0.2)
+        assert sup.running_router_count() == 2, sup.state()
+        assert sup.router_slot_by_index(1).pid != victim_pid
+        assert sup.last_router_recovery_s is not None
+
+        # The successor replayed the predecessor's journal: the killed
+        # router had accepted-but-uncompleted entries (in-flight RPCs
+        # died with its sockets), and every one of them was processed —
+        # deduped against replica-reported completions or re-dispatched
+        # with a fresh epoch.
+        replay_deadline = time.monotonic() + 60
+        total = 0
+        while time.monotonic() < replay_deadline:
+            port = sup.router_slot_by_index(1).ports["metrics_port"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/snapshotz", timeout=10
+            ) as resp:
+                snap = json.loads(resp.read().decode())
+            series = snap["metrics"].get(
+                "fleet_router_journal_replays_total", {}
+            ).get("series", [])
+            total = sum(s["value"] for s in series)
+            if total > 0:
+                break
+            time.sleep(0.5)
+        assert total > 0, "successor never replayed the journal"
+    finally:
+        sup.close()
+        if client is not None:
+            client.close()
+
+    # Postmortem over the flushed logs: across every replica engine's
+    # span log, no trace id was SERVED twice — the client's failover
+    # retries and the successor's replay both deduped against the
+    # replicas' idempotency caches instead of re-executing.
+    events = _drill_events(tele)
+    served_by_tid: "dict[str, int]" = {}
+    for e in events:
+        if (
+            e.get("kind") == "span" and e.get("name") == "serve.request"
+            and e["attrs"].get("outcome") == "served"
+        ):
+            served_by_tid[e["trace_id"]] = (
+                served_by_tid.get(e["trace_id"], 0) + 1
+            )
+    assert served_by_tid, "no engine spans flushed"
+    doubles = {t: n for t, n in served_by_tid.items() if n > 1}
+    assert not doubles, f"double-served trace ids: {doubles}"
 
 
 def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
