@@ -23,7 +23,10 @@ dynamic micro-batching throughput vs the batch-size-1 serial baseline with
 request-latency percentiles (``BENCH_SERVING=0`` disables). The
 ``sp2x2_overlap`` extra runs the spatial-parallel train step's
 monolithic-vs-decomposed conv A/B on a CPU-mesh subprocess and embeds both
-arms' measured ``trace_overlap_ratio`` (``BENCH_SP_OVERLAP=0`` disables).
+arms' measured ``trace_overlap_ratio`` (``BENCH_SP_OVERLAP=0`` disables);
+``serving_sharded`` runs the same A/B on the serving hot path — a
+2×2-sharded engine under closed-loop load per arm, ratio + per-request
+p99 per arm (``BENCH_SERVING_SHARDED=0`` disables).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -727,6 +730,44 @@ def _measure_sp_overlap() -> dict:
     return out
 
 
+def _measure_serving_sharded() -> dict:
+    """Sharded-serving overlap A/B extra: a 2×2 spatially-sharded engine
+    under closed-loop load with the monolithic AND decomposed conv impl,
+    embedding both arms' measured ``trace_overlap_ratio`` + per-request
+    latency (``analyze bench-history`` trends the ratio normal-sign and
+    p99 inverted). Same subprocess rationale as ``_measure_sp_overlap``:
+    the 4-virtual-device CPU tile mesh must exist regardless of the
+    bench headline's backend, and the property under measurement is the
+    compiled schedule's freedom, not CPU wall-clock."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("MPI4DL_TPU_CONV_OVERLAP", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analyze", "serving-sharded",
+         "--size", "32", "--requests", "64", "--trials", "2",
+         "--json", "-"],
+        env=env, capture_output=True, text=True, timeout=900, cwd=repo,
+    )
+    line = next(
+        (ln for ln in reversed(proc.stdout.splitlines())
+         if ln.startswith("{")), None,
+    )
+    if line is None:
+        raise RuntimeError(
+            f"serving-sharded emitted no JSON (rc={proc.returncode}): "
+            f"{proc.stderr[-300:]}"
+        )
+    out = json.loads(line)
+    out["rc"] = proc.returncode
+    return out
+
+
 def _serving_attribution(trace_dir, lint_report) -> "dict | None":
     """Measured device-time attribution of the serving load run
     (analysis/trace.py over the engine's own ``mpi4dl_serve_batch``
@@ -1148,6 +1189,14 @@ def main():
     # bench-history can trend the overlap trajectory per arm.
     if os.environ.get("BENCH_SP_OVERLAP", "1") != "0":
         run_extra("sp2x2_overlap", _measure_sp_overlap, est_seconds=240.0)
+
+    # Sharded-serving overlap A/B (CPU-mesh subprocess): the same two
+    # conv impls on the SERVING hot path — a 2x2-sharded engine under
+    # closed-loop load per arm, measured trace_overlap_ratio + p99
+    # latency per arm trended by bench-history (latency inverted).
+    if os.environ.get("BENCH_SERVING_SHARDED", "1") != "0":
+        run_extra("serving_sharded", _measure_serving_sharded,
+                  est_seconds=300.0)
 
     if which in ("resnet", "all") and not on_cpu:
         def peak_px():

@@ -139,8 +139,11 @@ def extract_series(result: dict) -> "dict[str, float]":
                 rps = rec.get("rps")
                 if isinstance(rps, (int, float)):
                     out[f"{name}.sched_rps[{arm}]"] = float(rps)
-        # Overlap A/B extra (sp2x2_overlap): per-arm measured overlap
-        # ratio (falling fails) and SP train-step time (growing fails).
+        # Overlap A/B extras (sp2x2_overlap, serving_sharded): per-arm
+        # measured overlap ratio (falling fails), SP train-step time
+        # (growing fails), and — serving arms only — per-request p99
+        # latency with the INVERTED sign plus throughput with the
+        # normal sign.
         arms = entry.get("arms")
         if isinstance(arms, dict):
             for arm, rec in arms.items():
@@ -152,6 +155,14 @@ def extract_series(result: dict) -> "dict[str, float]":
                 st = rec.get("step_time_s")
                 if isinstance(st, (int, float)):
                     out[f"{name}.step_time_s[{arm}]"] = float(st)
+                lat = rec.get("latency_ms")
+                if isinstance(lat, dict) and isinstance(
+                    lat.get("p99"), (int, float)
+                ):
+                    out[f"{name}.latency_p99_ms[{arm}]"] = float(lat["p99"])
+                rps = rec.get("throughput_rps")
+                if isinstance(rps, (int, float)):
+                    out[f"{name}.rps[{arm}]"] = float(rps)
     return out
 
 
@@ -168,6 +179,7 @@ def lower_is_better(key: str) -> bool:
         or ".step_time_s" in key
         or key.endswith(".tail_p99_p50_ratio")
         or ".sched_tight_p99_ms" in key
+        or ".latency_p99_ms" in key
     )
 
 

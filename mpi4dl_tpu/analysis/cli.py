@@ -35,7 +35,12 @@ and bisects the max feasible px/bucket
 step's halo/compute overlap A/B — monolithic vs decomposed spatial conv
 — with live trace attribution, partition-math lint, and the
 ``trace-overlap-crosscheck`` on each arm
-(:mod:`mpi4dl_tpu.analysis.overlap_bench`).
+(:mod:`mpi4dl_tpu.analysis.overlap_bench`);
+``python -m mpi4dl_tpu.analyze serving-sharded`` runs the same A/B on the
+SERVING hot path — a spatially-sharded ServingEngine under closed-loop
+load per arm, with per-request latency, the mesh-derived lint gate, and
+the bit-identity crosscheck between arms
+(:mod:`mpi4dl_tpu.analysis.serving_overlap`).
 """
 
 from __future__ import annotations
@@ -181,6 +186,14 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.overlap_bench import main as sp_overlap
 
         return sp_overlap(argv[1:])
+    if argv and argv[0] == "serving-sharded":
+        # Sharded-serving overlap A/B (monolithic vs decomposed conv on
+        # the serving hot path): builds its own CPU tile mesh like
+        # sp-overlap, measures a load-run capture per arm, lints both
+        # programs against the mesh-derived halo window.
+        from mpi4dl_tpu.analysis.serving_overlap import main as serving_ab
+
+        return serving_ab(argv[1:])
     if argv and argv[0] == "memory-plan":
         # Feasibility planner. Its artifact mode (committed peaks vs a
         # limit) is pure JSON and must dispatch before any backend

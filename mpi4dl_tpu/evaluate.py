@@ -306,6 +306,72 @@ def make_spatial_eval_step(trainer):
     return fn
 
 
+def aot_compile_spatial_predict(
+    trainer,
+    params,
+    batch_stats,
+    example_shape: Sequence[int],
+    buckets: Sequence[int],
+    dtype=jnp.float32,
+) -> dict:
+    """Sharded counterpart of :func:`aot_compile_predict`: AOT-lower the
+    trainer's spatially-partitioned frozen-stats forward once per batch
+    bucket, over the trainer's own ``tile_h×tile_w`` mesh.
+
+    Each executable runs the :func:`make_spatial_eval_step` forward —
+    tile-local spatial cells with halo exchanges, the SP→LP tile merge,
+    then the replicated head — and returns the logits instead of metrics,
+    so the serving engine can put a model whose single-chip forward does
+    not fit one device directly on its request hot loop. ``params`` /
+    ``batch_stats`` must already be placed replicated on the mesh
+    (``NamedSharding(mesh, P())``); the input bucket is lowered with the
+    trainer's ``x_spec`` sharding attached, so the compiled executable
+    accepts exactly the staged arrays the sharded predictor produces.
+
+    Same no-surprise-JIT contract as the single-chip path: compilation
+    happens here, at serving warm-up, and calling a ``Compiled`` object
+    can never trace or compile again.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi4dl_tpu.compat import shard_map
+    from mpi4dl_tpu.config import AXIS_DATA
+
+    mesh = trainer.mesh
+
+    def local(p, s, x):
+        from mpi4dl_tpu.ops.halo_pallas import reset_collective_ids
+
+        reset_collective_ids()
+        with bn_stats_mode("running"):
+            logits, _ = _spatial_apply(trainer, p, s, x, False)
+        return logits
+
+    # Logits come out batch-sharded over the data axis only (size 1 on a
+    # serving mesh — the whole bucket on every tile) and replicated over
+    # the tile axes: every tile device computes the identical post-join
+    # head on the gathered activations.
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), trainer.x_spec),
+            out_specs=P(AXIS_DATA),
+            check_vma=False,
+        )
+    )
+    x_sharding = NamedSharding(mesh, trainer.x_spec)
+    out = {}
+    for b in sorted({int(b) for b in buckets}):
+        if b < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {b}")
+        xs = jax.ShapeDtypeStruct(
+            (b, *tuple(example_shape)), dtype, sharding=x_sharding
+        )
+        out[b] = fn.lower(params, batch_stats, xs).compile()
+    return out
+
+
 def spatial_collect_batch_stats(trainer, params, batches) -> list:
     """Exact pooled BN statistics computed on the trainer's own spatial
     cells over its mesh — the sharded counterpart of

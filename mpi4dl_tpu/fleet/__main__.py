@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "spawning anything (pure dispatch)")
     # worker / model
     p.add_argument("--image-size", type=int, default=16)
+    p.add_argument("--mesh", default=None, metavar="HxW",
+                   help="every replica claims a tile_h x tile_w device "
+                        "subset and serves the spatially-sharded forward "
+                        "(worker --mesh): shard for model size, "
+                        "replicate for traffic — two orthogonal axes")
     p.add_argument("--depth", type=int, default=None,
                    help="synthetic ResNet-v2 depth (9n+2); default tiny")
     p.add_argument("--max-batch", type=int, default=2)
@@ -173,6 +178,8 @@ def _worker_args(args) -> "list[str]":
     ]
     if args.depth is not None:
         out += ["--depth", str(args.depth)]
+    if args.mesh:
+        out += ["--mesh", args.mesh]
     if args.telemetry_dir:
         out += ["--telemetry-dir", args.telemetry_dir]
     if args.slo_classes:

@@ -71,6 +71,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", type=int, default=16)
     p.add_argument("--depth", type=int, default=None,
                    help="synthetic ResNet-v2 depth (9n+2); default tiny")
+    p.add_argument("--mesh", default=None, metavar="HxW",
+                   help="claim a tile_h x tile_w device subset and run "
+                        "the engine's forward spatially sharded over it "
+                        "(serve/sharded.py; the synthetic model becomes "
+                        "a spatial ResNet-v1 front, --depth then 6n+2). "
+                        "The mesh shape rides the /healthz payload, so "
+                        "shard-for-model-size and replicate-for-traffic "
+                        "are visible as two orthogonal fleet axes")
+    p.add_argument("--spatial-cells", type=int, default=2,
+                   help="leading spatial cells of the sharded synthetic "
+                        "model (--mesh only)")
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--max-batch", type=int, default=2)
     p.add_argument("--max-wait-ms", type=float, default=2.0)
@@ -356,6 +367,17 @@ def main(argv=None) -> int:
 
     apply_platform_env()
 
+    mesh_shape = None
+    if args.mesh:
+        from mpi4dl_tpu.serve.sharded import parse_mesh
+
+        mesh_shape = parse_mesh(args.mesh)
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # The tile mesh needs virtual devices before backend init.
+            from mpi4dl_tpu.compat import set_cpu_devices
+
+            set_cpu_devices(max(8, mesh_shape[0] * mesh_shape[1]))
+
     import jax
     import jax.numpy as jnp
 
@@ -367,20 +389,7 @@ def main(argv=None) -> int:
     from mpi4dl_tpu.utils import get_depth
 
     size = args.image_size
-    depth = args.depth if args.depth is not None else get_depth(2, 1)
-    cells = get_resnet_v2(
-        depth=depth, num_classes=args.classes, pool_kernel=size // 4
-    )
-    rng = np.random.default_rng(0)
-    params = init_cells(
-        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
-    )
-    stats = collect_batch_stats(
-        cells, params,
-        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
-    )
-    engine = ServingEngine(
-        cells, params, stats, example_shape=(size, size, 3),
+    engine_kw = dict(
         max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
         max_queue=args.max_queue,
         default_deadline_s=args.default_deadline_s,
@@ -392,6 +401,37 @@ def main(argv=None) -> int:
         slo_classes=args.slo_classes,
         scheduler=args.scheduler,
     )
+    if mesh_shape is not None:
+        # Sharded replica: this process claims a device SUBSET shaped
+        # tile_h x tile_w and serves the spatially-partitioned forward
+        # on it — the fleet's replicate-for-traffic axis stays above.
+        from mpi4dl_tpu.serve.sharded import synthetic_sharded_engine
+
+        engine = synthetic_sharded_engine(
+            mesh_shape, image_size=size,
+            depth=args.depth if args.depth is not None else 8,
+            num_classes=args.classes, spatial_cells=args.spatial_cells,
+            **engine_kw,
+        )
+    else:
+        depth = args.depth if args.depth is not None else get_depth(2, 1)
+        cells = get_resnet_v2(
+            depth=depth, num_classes=args.classes, pool_kernel=size // 4
+        )
+        rng = np.random.default_rng(0)
+        params = init_cells(
+            cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+        )
+        stats = collect_batch_stats(
+            cells, params,
+            [jnp.asarray(
+                rng.standard_normal((4, size, size, 3)), jnp.float32
+            )],
+        )
+        engine = ServingEngine(
+            cells, params, stats, example_shape=(size, size, 3),
+            **engine_kw,
+        )
 
     chaos = _ChaosState()
     # Chaos seam: the wedge gate runs INSIDE the batcher thread's
@@ -415,6 +455,10 @@ def main(argv=None) -> int:
         snap["queue_depth"] = engine.queue_depth()
         snap["draining"] = draining.is_set()
         snap["pid"] = os.getpid()
+        # The device subset this replica claims: (1,1) = one chip,
+        # tile_h x tile_w = a sharded forward. Routers/operators read
+        # shard-for-model-size here, orthogonal to replica count.
+        snap["mesh"] = list(engine.mesh_shape)
         return snap
 
     metrics_server = telemetry.MetricsServer(

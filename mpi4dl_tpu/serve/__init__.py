@@ -14,6 +14,10 @@ Entry points:
 
 - :class:`ServingEngine` / :meth:`ServingEngine.from_checkpoint` — the
   library surface;
+- :mod:`mpi4dl_tpu.serve.sharded` — multi-chip sharded serving: every
+  bucket runs as the trainer's spatially-partitioned forward over a
+  ``tile_h×tile_w`` mesh (``--mesh HxW``; docs/SERVING.md "Multi-chip
+  sharded serving"), for models whose single-chip forward doesn't fit;
 - ``python -m mpi4dl_tpu.serve`` — CLI: restore (or synthesize) a model,
   warm up, drive a closed/open-loop load test, print one JSON report;
 - :mod:`mpi4dl_tpu.serve.loadgen` — the load-generation library behind
@@ -48,4 +52,11 @@ from mpi4dl_tpu.serve.engine import (  # noqa: F401
     DrainedError,
     QueueFullError,
     ServingEngine,
+    SingleChipPredictor,
+)
+from mpi4dl_tpu.serve.sharded import (  # noqa: F401
+    ShardedPredictor,
+    parse_mesh,
+    sharded_engine,
+    synthetic_sharded_engine,
 )

@@ -14,6 +14,8 @@ Examples::
         --rate 200 --duration 10 --deadline-ms 50 --lint
     JAX_PLATFORMS=cpu python -m mpi4dl_tpu.serve --requests 512 \
         --slo-availability 99.9 --slo-latency-ms 50 --metrics-port 0
+    JAX_PLATFORMS=cpu python -m mpi4dl_tpu.serve --mesh 2x2 \
+        --requests 64 --lint   # spatially-sharded forward, halo-window gate
 """
 
 from __future__ import annotations
@@ -39,6 +41,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--calib-batches", type=int, default=2,
                    help="synthetic BN calibration batches")
+    p.add_argument("--mesh", default=None, metavar="HxW",
+                   help="spatially shard the serving forward over a "
+                        "tile_h x tile_w device mesh (e.g. 2x2, 1x2): "
+                        "each request's H/W partitions across chips with "
+                        "halo exchanges, the hlolint gate flips to the "
+                        "partition-math halo-permute window, and the "
+                        "synthetic model becomes a spatial ResNet-v1 "
+                        "front (default: single-chip engine)")
+    p.add_argument("--conv-overlap", default=None,
+                   choices=("monolithic", "decomposed"),
+                   help="spatial conv/pool impl for the sharded forward "
+                        "(overlap_decompose: interior hides the halo "
+                        "permute; bit-identical outputs); default "
+                        "inherits MPI4DL_TPU_CONV_OVERLAP")
+    p.add_argument("--spatial-cells", type=int, default=3,
+                   help="leading cells of the sharded synthetic model "
+                        "that run spatially partitioned (--mesh only)")
     p.add_argument("--max-batch", type=int, default=8,
                    help="largest micro-batch bucket (power of two)")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
@@ -160,6 +179,25 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _sharded_synthetic_engine(args, mesh_shape):
+    """``--mesh HxW``: the sharded zero-artifact path — a spatial
+    ResNet-v1 front over the tile mesh (serve/sharded.py), batcher and
+    telemetry stack identical to the single-chip engine's."""
+    from mpi4dl_tpu.serve.sharded import synthetic_sharded_engine
+
+    return synthetic_sharded_engine(
+        mesh_shape, image_size=args.image_size,
+        depth=args.depth if args.depth != 11 else 8,  # v1 depths are 6n+2
+        num_classes=args.classes, spatial_cells=args.spatial_cells,
+        calib_batches=args.calib_batches, conv_overlap=args.conv_overlap,
+        max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
+        max_queue=args.max_queue,
+        default_deadline_s=args.deadline_ms / 1e3,
+        metrics_port=args.metrics_port, telemetry_dir=args.telemetry_dir,
+        **_liveness_kw(args),
+    )
+
+
 def _synthetic_engine(args):
     import jax
     import jax.numpy as jnp
@@ -237,9 +275,23 @@ def _slo_config(args):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    import os
+
     from mpi4dl_tpu.utils import apply_platform_env
 
     apply_platform_env()
+
+    mesh_shape = None
+    if args.mesh:
+        from mpi4dl_tpu.serve.sharded import parse_mesh
+
+        mesh_shape = parse_mesh(args.mesh)
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # The tile mesh needs virtual devices before backend init
+            # (the same simulation the test suite / analyze CLI use).
+            from mpi4dl_tpu.compat import set_cpu_devices
+
+            set_cpu_devices(max(8, mesh_shape[0] * mesh_shape[1]))
 
     from mpi4dl_tpu.serve import ServingEngine
     from mpi4dl_tpu.serve.loadgen import (
@@ -248,6 +300,11 @@ def main(argv=None) -> int:
         serial_throughput,
     )
 
+    if args.ckpt and mesh_shape is not None:
+        print("--ckpt with --mesh is not supported yet: the sharded path "
+              "needs the model's spatial twin (docs/SERVING.md)",
+              file=sys.stderr)
+        return 2
     if args.ckpt:
         engine = ServingEngine.from_checkpoint(
             args.ckpt, max_batch=args.max_batch,
@@ -256,6 +313,8 @@ def main(argv=None) -> int:
             metrics_port=args.metrics_port, telemetry_dir=args.telemetry_dir,
             **_liveness_kw(args),
         )
+    elif mesh_shape is not None:
+        engine = _sharded_synthetic_engine(args, mesh_shape)
     else:
         engine = _synthetic_engine(args)
 
@@ -280,6 +339,7 @@ def main(argv=None) -> int:
         "model": "checkpoint:" + args.ckpt if args.ckpt else
                  f"synthetic_resnet{args.depth}_{args.image_size}px",
         "buckets": list(engine.buckets),
+        "mesh": list(engine.mesh_shape),
     }
     if engine.metrics_port is not None:
         report["metrics_port"] = engine.metrics_port

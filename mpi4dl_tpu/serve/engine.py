@@ -204,6 +204,81 @@ class _Join:
         self.future.set_exception(exc)
 
 
+class SingleChipPredictor:
+    """The engine's compile/stage/run backend for the default one-chip
+    replica: every bucket compiles to a one-device executable with zero
+    collectives, and the hlolint gate it derives
+    (``Expectations(single_chip=True)``) enforces exactly that.
+
+    This is the seam the multi-chip path plugs into
+    (:class:`mpi4dl_tpu.serve.sharded.ShardedPredictor`): the engine's
+    batcher/scheduler/telemetry stack talks only to this interface —
+    ``compile_bucket`` / ``stage`` / ``run`` / ``expectations`` — so
+    sharding the forward never touches the host-side hot loop."""
+
+    program = "serve_predict"
+    mesh_shape = (1, 1)
+
+    def __init__(self, cells, params, batch_stats, example_shape, dtype):
+        import jax
+
+        self.cells = tuple(cells)
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self.dtype = dtype
+        self.device = jax.devices()[0]
+        # Params/stats live on the device once; per-request traffic is
+        # the input batch only.
+        self.params = jax.device_put(params, self.device)
+        self.stats = jax.device_put(batch_stats, self.device)
+
+    @property
+    def num_devices(self) -> int:
+        return 1
+
+    def halo_shifts(self) -> int:
+        """Forward halo-shift permutes in the serving forward (the
+        partition-math input of the sharded lint window): a one-chip
+        program exchanges nothing."""
+        return 0
+
+    def compile_bucket(self, bucket: int):
+        from mpi4dl_tpu.evaluate import aot_compile_predict
+
+        return aot_compile_predict(
+            self.cells, self.params, self.stats, self.example_shape,
+            [bucket], dtype=self.dtype,
+        )[bucket]
+
+    def stage(self, batch):
+        """Async host→device transfer of one padded batch."""
+        import jax
+
+        return jax.device_put(batch, self.device)
+
+    def run(self, compiled, staged):
+        """Dispatch one pre-compiled bucket executable (async). Accepts
+        an un-staged host batch too (the synchronous predict_one path)."""
+        if isinstance(staged, np.ndarray):
+            staged = self.stage(staged)
+        return compiled(self.params, self.stats, staged)
+
+    def expectations(self):
+        """Mesh-derived hlolint expectations: one chip → ANY collective
+        in the compiled forward is a resharding regression."""
+        from mpi4dl_tpu.analysis.rules import Expectations
+
+        return Expectations(single_chip=True)
+
+    def platform(self) -> str:
+        return self.device.platform
+
+    def limit_device(self):
+        """The device whose memory limit bounds one bucket's footprint
+        (per-chip share: the guard compares against ONE device even on a
+        multi-chip mesh)."""
+        return self.device
+
+
 class ServingEngine:
     """Serves single-example requests through pre-compiled bucketed
     frozen-stats forwards of a calibrated model.
@@ -295,6 +370,16 @@ class ServingEngine:
         scheduler's deprioritize/shed feedback. Unclassed submissions
         land in the class named ``default`` when present, else the
         LAST configured class.
+    predictor: the compile/stage/run backend for the serving forward.
+        None (default) builds a :class:`SingleChipPredictor` from
+        cells/params/batch_stats; a
+        :class:`~mpi4dl_tpu.serve.sharded.ShardedPredictor` runs every
+        bucket as a spatially-partitioned ``shard_map`` forward over a
+        ``tile_h×tile_w`` mesh instead (docs/SERVING.md "Multi-chip
+        sharded serving"). With a predictor, cells/params/batch_stats
+        are ignored — use :meth:`from_predictor`. The hlolint gate,
+        footprint ledger, and memory guard all derive from the
+        predictor (mesh-derived expectations, per-chip share).
     scheduler: ``"edf"`` (default) — the continuous scheduler:
         deadline-ordered dispatch across class queues, in-flight
         re-admission (no formation window), burn-rate feedback.
@@ -335,11 +420,10 @@ class ServingEngine:
         slo_classes=None,
         scheduler: str = "edf",
         shed_ratio: float = 0.5,
+        predictor=None,
     ):
-        import jax
         import jax.numpy as jnp
 
-        from mpi4dl_tpu.evaluate import aot_compile_predict
         from mpi4dl_tpu.telemetry import memory as memobs
 
         dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
@@ -357,11 +441,14 @@ class ServingEngine:
             o for o in (c.objective() for c in self._classes)
             if o is not None
         ]
-        self._device = jax.devices()[0]
-        # Params/stats live on the device once; per-request traffic is the
-        # input batch only.
-        self._params = jax.device_put(params, self._device)
-        self._stats = jax.device_put(batch_stats, self._device)
+        # The compile/stage/run backend: single-chip by default, or an
+        # injected mesh-aware predictor (serve/sharded.py) — the batcher,
+        # scheduler, and telemetry above never see the difference.
+        if predictor is None:
+            predictor = SingleChipPredictor(
+                cells, params, batch_stats, self.example_shape, dtype
+            )
+        self._predictor = predictor
 
         # The registry (and the memory machinery reading/writing it)
         # exists BEFORE warm-up: the footprint ledger records each
@@ -387,7 +474,7 @@ class ServingEngine:
         self._memory_limit = (
             int(memory_limit_bytes)
             if memory_limit_bytes is not None
-            else memobs.device_memory_limit(self._device)
+            else memobs.device_memory_limit(self._predictor.limit_device())
         )
         self.refused_buckets: "dict[int, dict]" = {}
         telemetry.declare(self.registry, "oom_reports_total")
@@ -403,22 +490,19 @@ class ServingEngine:
         self.warm_latency_s: dict[int, float] = {}
         for b in self._buckets:
             try:
-                compiled = aot_compile_predict(
-                    cells, self._params, self._stats, self.example_shape,
-                    [b], dtype=dtype,
-                )[b]
+                compiled = self._predictor.compile_bucket(b)
             except Exception as e:  # noqa: BLE001 — compile-time OOM is a
                 # memory fact about the bucket, not an engine defect
                 if memory_guard and memobs.is_oom_error(e):
                     self._refuse_bucket(b, "compile_oom", error=e)
                     continue
                 memobs.emit_oom_report(
-                    e, program="serve_predict", bucket=b,
+                    e, program=self._predictor.program, bucket=b,
                     registry=self.registry, events=self._events,
                 )
                 raise
             entry = self.memory_ledger.record_compiled(
-                "serve_predict", compiled, bucket=b
+                self._predictor.program, compiled, bucket=b
             )
             peak = entry.get("peak_bytes")
             if (
@@ -444,7 +528,7 @@ class ServingEngine:
         for b in self._buckets:
             z = np.zeros((b, *self.example_shape), self._np_dtype)
             t0 = time.perf_counter()
-            np.asarray(self._compiled[b](self._params, self._stats, z))
+            np.asarray(self._predictor.run(self._compiled[b], z))
             self.warm_latency_s[b] = time.perf_counter() - t0
         self.assert_warm()
 
@@ -510,6 +594,12 @@ class ServingEngine:
         warm = decl("serve_warm_latency_seconds")
         for b, t in self.warm_latency_s.items():
             warm.set(t, bucket=b)
+        # Mesh facts of the serving forward: device count (1 = the
+        # single-chip replica; tile_h*tile_w for a sharded one) and the
+        # forward halo-shift permute count the sharded lint window is
+        # derived from (0 on a single chip — nothing to exchange).
+        decl("serve_mesh_devices").set(self._predictor.num_devices)
+        decl("serve_halo_shifts").set(self._predictor.halo_shifts())
 
         # -- liveness + postmortem ------------------------------------------
         self.health = telemetry.HealthState(registry=self.registry)
@@ -609,6 +699,19 @@ class ServingEngine:
     # -- construction helpers ------------------------------------------------
 
     @classmethod
+    def from_predictor(cls, predictor, **kw) -> "ServingEngine":
+        """Engine over an already-built predictor (the multi-chip entry:
+        ``serve.sharded`` constructs a :class:`ShardedPredictor` and
+        hands it here — batcher/scheduler/telemetry stack unchanged)."""
+        return cls(
+            None, None, None,
+            example_shape=predictor.example_shape,
+            dtype=predictor.dtype,
+            predictor=predictor,
+            **kw,
+        )
+
+    @classmethod
     def from_checkpoint(cls, path_or_dir: str, **kw) -> "ServingEngine":
         """Engine from a self-describing checkpoint path alone: metadata →
         rebuilt cells, restored params, calibrated ``batch_stats`` (which
@@ -641,6 +744,14 @@ class ServingEngine:
         """The normalized :class:`~mpi4dl_tpu.serve.SLOClass` tuple."""
         return self._classes
 
+    @property
+    def mesh_shape(self) -> tuple[int, int]:
+        """``(tile_h, tile_w)`` of the serving forward's mesh — ``(1, 1)``
+        for the single-chip replica. Fleet workers surface it on
+        ``/healthz`` so shard-for-model-size (mesh) and
+        replicate-for-traffic (fleet) read as two orthogonal axes."""
+        return tuple(self._predictor.mesh_shape)
+
     def queue_depth(self) -> int:
         """Total requests waiting across every class queue (the
         enriched-/healthz payload the fleet router scrapes)."""
@@ -663,7 +774,7 @@ class ServingEngine:
         entry = {"reason": reason, **facts}
         if error is not None:
             ev = memobs.emit_oom_report(
-                error, program="serve_predict", bucket=bucket,
+                error, program=self._predictor.program, bucket=bucket,
                 registry=self.registry, events=self._events,
             )
             entry["oom"] = ev["attrs"]["parsed"]
@@ -857,10 +968,9 @@ class ServingEngine:
         executable, bypassing the queue — the serial baseline the load
         generator compares dynamic batching against."""
         x = np.asarray(x, self._np_dtype)
-        batch = pad_batch([x], bucket_for(1, self._buckets), self._np_dtype)
-        out = self._compiled[bucket_for(1, self._buckets)](
-            self._params, self._stats, batch
-        )
+        b = bucket_for(1, self._buckets)
+        batch = pad_batch([x], b, self._np_dtype)
+        out = self._predictor.run(self._compiled[b], batch)
         return np.asarray(out)[0]
 
     def stats(self) -> dict:
@@ -880,6 +990,7 @@ class ServingEngine:
         out["scheduler"] = self._sched.state()
         out["pad_waste_ratio"] = padded / total if total else 0.0
         out["buckets"] = list(self._buckets)
+        out["mesh"] = list(self.mesh_shape)
         out["warm_latency_s"] = dict(self.warm_latency_s)
         out["healthy"] = self.health.healthy
         out["memory"] = self.memory_view()
@@ -891,7 +1002,7 @@ class ServingEngine:
         configured/device limit, and the latest live device sample."""
         buckets = {}
         for b in self._buckets:
-            e = self.memory_ledger.get("serve_predict", bucket=b)
+            e = self.memory_ledger.get(self._predictor.program, bucket=b)
             if e is not None:
                 buckets[str(b)] = e.get("peak_bytes")
         return {
@@ -959,21 +1070,26 @@ class ServingEngine:
         return self.flight.dump(path=path, reason=reason)
 
     def lint_report(self, bucket: int | None = None):
-        """hlolint gate over a serving executable's HLO: the single-chip
-        serve path must contain zero collectives and no stray resharding
-        (:mod:`mpi4dl_tpu.analysis`, rule ``single-chip-collectives``)."""
+        """hlolint gate over a serving executable's HLO, with expectations
+        DERIVED FROM THE MESH rather than hardcoded: a single-chip engine
+        keeps the zero-collectives gate (rule ``single-chip-collectives``
+        — any collective is resharding that regressed off the one
+        device), while a sharded engine flips to the partition-math
+        halo-permute window (tile grid + counted forward halo shifts,
+        rule ``halo-permute-count`` — the same gate the train step rides)
+        plus the standing stray-resharding rules."""
         from mpi4dl_tpu.analysis import analyze_compiled
-        from mpi4dl_tpu.analysis.rules import Expectations
 
         from mpi4dl_tpu.analysis.metrics import publish_report
 
         b = bucket if bucket is not None else max(self._buckets)
         rep = analyze_compiled(
             self._compiled[b],
-            expected=Expectations(single_chip=True),
-            platform=self._device.platform,
-            config={"program": "serve_predict", "bucket": b,
-                    "example_shape": list(self.example_shape)},
+            expected=self._predictor.expectations(),
+            platform=self._predictor.platform(),
+            config={"program": self._predictor.program, "bucket": b,
+                    "example_shape": list(self.example_shape),
+                    "mesh_shape": list(self.mesh_shape)},
         )
         publish_report(rep, self.registry)  # verdict scrapes with the rest
         return rep
@@ -994,7 +1110,7 @@ class ServingEngine:
                 # Structured forensics BEFORE the crash dump, so the
                 # oom.report sits in the ring the dump writes out.
                 memobs.emit_oom_report(
-                    e, program="serve_predict",
+                    e, program=self._predictor.program,
                     registry=self.registry, events=self._events,
                     flight=self.flight,
                 )
@@ -1027,7 +1143,7 @@ class ServingEngine:
                         # ring — the postmortem names the program, the
                         # bucket, and the largest buffers.
                         memobs.emit_oom_report(
-                            e, program="serve_predict",
+                            e, program=self._predictor.program,
                             bucket=bucket_for(len(reqs), self._buckets),
                             registry=self.registry, events=self._events,
                             flight=self.flight, dump=True,
@@ -1076,8 +1192,6 @@ class ServingEngine:
         return reqs
 
     def _dispatch(self, reqs: "list[_Request]"):
-        import jax
-
         bucket = bucket_for(len(reqs), self._buckets)
         # The executable must pre-exist — never compile on a live request.
         if bucket not in self._compiled:
@@ -1098,10 +1212,8 @@ class ServingEngine:
             out = self._dispatch_sampled(batch, bucket, seq)
         if out is None:
             with annotate_step("mpi4dl_serve_batch", seq):
-                staged = jax.device_put(batch, self._device)  # async H2D
-                out = self._compiled[bucket](
-                    self._params, self._stats, staged
-                )
+                staged = self._predictor.stage(batch)  # async H2D
+                out = self._predictor.run(self._compiled[bucket], staged)
         staged_t = time.monotonic()
         for r in reqs:
             r.staged_t = staged_t
@@ -1139,9 +1251,9 @@ class ServingEngine:
             try:
                 with profiler_trace(tmp):
                     with annotate_step("mpi4dl_serve_batch", seq):
-                        staged = jax.device_put(batch, self._device)
-                        out = self._compiled[bucket](
-                            self._params, self._stats, staged
+                        staged = self._predictor.stage(batch)
+                        out = self._predictor.run(
+                            self._compiled[bucket], staged
                         )
                         jax.block_until_ready(out)
             except Exception as e:  # noqa: BLE001 — sampling must never

@@ -1,0 +1,324 @@
+"""Multi-chip sharded serving: the trainer's spatial forward on the hot loop.
+
+The paper's whole point is spatial parallelism for images too large for
+one device — partition H×W across chips with a halo exchange at every
+conv/pool — yet serving was single-chip-per-replica: training peaked at
+4096² per chip and anything bigger could not be *served* at all. This
+module closes that gap by plugging the sharded frozen-stats forward
+(:func:`mpi4dl_tpu.evaluate.aot_compile_spatial_predict`, the
+``make_spatial_eval_step``-style ``shard_map`` program over the trainer's
+``tile_h×tile_w`` mesh) into the :class:`~mpi4dl_tpu.serve.ServingEngine`
+through its predictor seam. Everything above the forward — continuous
+batcher, EDF class scheduler, deadlines, spans, SLO evaluator, tail
+watcher — is byte-for-byte the single-chip stack; the fleet then
+replicates sharded replicas for traffic, so **shard for model size,
+replicate for traffic** are two orthogonal scaling axes.
+
+Three existing subsystems become load-bearing on this path:
+
+- **lint** — :meth:`ShardedPredictor.expectations` derives the hlolint
+  gate from the mesh: the tile grid plus the counted forward halo shifts
+  (``Trainer.halo_shift_count``), so every warmed bucket's HLO is gated
+  by the partition-math halo-permute window (the train step's rule)
+  instead of the single-chip zero-collectives rule.
+- **overlap** — ``conv_overlap="decomposed"`` (or
+  ``MPI4DL_TPU_CONV_OVERLAP``) compiles every bucket with the PR-9
+  interior/boundary decomposition, putting the T3/FLUX
+  interior-hides-permute trade on a latency-critical path; the output is
+  bit-identical to the monolithic arm (same invariant as training) and
+  ``analyze serving-sharded`` measures both arms' ``trace_overlap_ratio``
+  with the ``trace-overlap-crosscheck`` gate.
+- **memory** — each bucket's compile-time footprint lands in the engine's
+  ledger as the PER-CHIP share (``shard_map`` peak is per device), so
+  ``analyze memory-plan`` math and the opt-in ``memory_guard`` answer
+  "which px/bucket fits a chip's share" before warm-up and refuse unfit
+  sharded buckets with reasons in ``stats()``.
+
+Bit-identity scope (same boundary as everywhere in this repo): the
+sharded forward is a DIFFERENT program from the plain one (tile-local
+convs + halo exchange vs one full-image conv), so sharded-vs-single-chip
+parity holds at the documented f32 reduction-order tolerance; the two
+OVERLAP arms of the *same* mesh are bit-identical to each other.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Sequence
+
+import numpy as np
+
+from mpi4dl_tpu.serve.engine import ServingEngine
+
+
+@contextlib.contextmanager
+def conv_overlap_env(impl: "str | None"):
+    """Pin ``MPI4DL_TPU_CONV_OVERLAP`` while tracing one arm's program
+    (the selector is read at trace time, per spatial windowed op).
+    ``None`` leaves the process environment alone."""
+    if impl is None:
+        yield
+        return
+    prev = os.environ.get("MPI4DL_TPU_CONV_OVERLAP")
+    os.environ["MPI4DL_TPU_CONV_OVERLAP"] = impl
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("MPI4DL_TPU_CONV_OVERLAP", None)
+        else:
+            os.environ["MPI4DL_TPU_CONV_OVERLAP"] = prev
+
+
+def parse_mesh(spec: str) -> tuple[int, int]:
+    """``"2x2"`` / ``"1x2"`` → ``(tile_h, tile_w)``. The CLI surface of
+    the mesh axis (worker ``--mesh``, serve ``--mesh``)."""
+    try:
+        th, tw = (int(p) for p in str(spec).lower().split("x"))
+    except ValueError:
+        raise ValueError(
+            f"mesh must look like HxW (e.g. 2x2, 1x2), got {spec!r}"
+        ) from None
+    if th < 1 or tw < 1:
+        raise ValueError(f"mesh extents must be >= 1, got {th}x{tw}")
+    return th, tw
+
+
+def serving_mesh_config(
+    mesh_shape: Sequence[int], image_size: int, num_classes: int = 10
+):
+    """A :class:`~mpi4dl_tpu.config.ParallelConfig` for a serving-only
+    spatial front on a ``tile_h×tile_w`` grid: square meshes slice
+    square, ``1×W`` vertical, ``H×1`` horizontal (the reference's three
+    ``slice_method``\\ s — a non-square non-strip grid has no slicing
+    rule and is rejected). ``data_parallel=1``: the whole bucket rides
+    every tile; the FLEET replicates for traffic."""
+    from mpi4dl_tpu.config import ParallelConfig
+
+    th, tw = (int(d) for d in mesh_shape)
+    if th == tw == 1:
+        raise ValueError(
+            "1x1 mesh is the single-chip engine — construct ServingEngine "
+            "directly instead of the sharded path"
+        )
+    if th == tw:
+        slice_method, parts = "square", th * tw
+    elif th == 1:
+        slice_method, parts = "vertical", tw
+    elif tw == 1:
+        slice_method, parts = "horizontal", th
+    else:
+        raise ValueError(
+            f"unsupported mesh {th}x{tw}: spatial slicing needs a square "
+            "grid, 1xW (vertical), or Hx1 (horizontal)"
+        )
+    return ParallelConfig(
+        batch_size=1, split_size=1, spatial_size=1,
+        num_spatial_parts=(parts,), slice_method=slice_method,
+        image_size=int(image_size), num_classes=num_classes,
+        data_parallel=1,
+    )
+
+
+class ShardedPredictor:
+    """Compile/stage/run backend running every bucket as the trainer's
+    spatially-partitioned forward over its ``tile_h×tile_w`` mesh.
+
+    trainer: a spatial :class:`~mpi4dl_tpu.train.Trainer` (its cells,
+        mesh, and ``x_spec`` define the program; no training state is
+        touched).
+    params / batch_stats: the calibrated triple's arrays; placed
+        replicated on the mesh here.
+    example_shape: per-request ``(H, W, C)`` — H/W must match the
+        trainer config's ``image_size`` (the tile geometry).
+    conv_overlap: ``"monolithic"`` / ``"decomposed"`` pins the spatial
+        conv/pool impl for every bucket compile (PR-9
+        ``overlap_decompose``); None inherits ``MPI4DL_TPU_CONV_OVERLAP``.
+    """
+
+    program = "serve_sharded"
+
+    def __init__(
+        self,
+        trainer,
+        params,
+        batch_stats,
+        example_shape: Sequence[int],
+        dtype=None,
+        conv_overlap: "str | None" = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi4dl_tpu.config import AXIS_TILE_H, AXIS_TILE_W
+
+        if conv_overlap not in (None, "monolithic", "decomposed"):
+            raise ValueError(
+                f"conv_overlap must be monolithic/decomposed/None, "
+                f"got {conv_overlap!r}"
+            )
+        self.trainer = trainer
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self.dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+        self.conv_overlap = conv_overlap
+        mesh = trainer.mesh
+        self.mesh_shape = (
+            mesh.shape[AXIS_TILE_H], mesh.shape[AXIS_TILE_W]
+        )
+        h, w = self.example_shape[0], self.example_shape[1]
+        th, tw = self.mesh_shape
+        if h % th or w % tw:
+            raise ValueError(
+                f"example {h}x{w} does not tile over the {th}x{tw} mesh"
+            )
+        # Params/stats live replicated on the mesh once; per-request
+        # traffic is the tile-sharded input batch only.
+        repl = NamedSharding(mesh, P())
+        self.params = jax.device_put(params, repl)
+        self.stats = jax.device_put(batch_stats, repl)
+        self._x_sharding = NamedSharding(mesh, trainer.x_spec)
+        self._halo_shifts: "int | None" = None
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh_shape[0] * self.mesh_shape[1]
+
+    def halo_shifts(self) -> int:
+        """Forward halo-shift permutes in one pass over the cells
+        (``Trainer.halo_shift_count``, an abstract trace) — the
+        partition-math input of the lint window. The decomposed overlap
+        arm calls ``halo_exchange`` exactly once per windowed op too
+        (the PR-9 invariant), so one count covers both arms."""
+        if self._halo_shifts is None:
+            self._halo_shifts = self.trainer.halo_shift_count(
+                self.params, (1, *self.example_shape), dtype=self.dtype
+            )
+        return self._halo_shifts
+
+    def compile_bucket(self, bucket: int):
+        from mpi4dl_tpu.evaluate import aot_compile_spatial_predict
+
+        with conv_overlap_env(self.conv_overlap):
+            return aot_compile_spatial_predict(
+                self.trainer, self.params, self.stats, self.example_shape,
+                [bucket], dtype=self.dtype,
+            )[bucket]
+
+    def stage(self, batch):
+        """Async host→mesh transfer: the bucket lands tile-sharded
+        (H over ``tile_h``, W over ``tile_w``) exactly as compiled."""
+        import jax
+
+        return jax.device_put(batch, self._x_sharding)
+
+    def run(self, compiled, staged):
+        if isinstance(staged, np.ndarray):
+            staged = self.stage(staged)
+        return compiled(self.params, self.stats, staged)
+
+    def expectations(self):
+        """Mesh-derived hlolint expectations: the partition-math
+        halo-permute window off the counted forward shifts — the gate
+        flip from the single-chip zero-collectives rule."""
+        from mpi4dl_tpu.analysis.rules import Expectations
+
+        return Expectations(
+            tile_shape=self.mesh_shape, halo_shifts=self.halo_shifts()
+        )
+
+    def platform(self) -> str:
+        return self.limit_device().platform
+
+    def limit_device(self):
+        """One tile device: the memory guard compares each bucket's
+        PER-CHIP footprint share against a single chip's limit."""
+        return self.trainer.mesh.devices.flat[0]
+
+
+def sharded_engine(
+    cells: Sequence[Any],
+    plain_cells: Sequence[Any],
+    num_spatial_cells: int,
+    params,
+    batch_stats,
+    example_shape: Sequence[int],
+    mesh_shape: Sequence[int] = (2, 2),
+    conv_overlap: "str | None" = None,
+    dtype=None,
+    mesh=None,
+    num_classes: int = 10,
+    **engine_kw,
+) -> ServingEngine:
+    """Build a spatially-sharded :class:`ServingEngine` from a calibrated
+    model: spatial cell list (first ``num_spatial_cells`` flagged
+    spatial), its plain twin, params, and BN stats — the same triple the
+    trainer and the single-chip engine consume. Calibrate small models
+    with :func:`~mpi4dl_tpu.evaluate.collect_batch_stats` on the plain
+    twin, or :func:`~mpi4dl_tpu.evaluate.spatial_collect_batch_stats`
+    when the full image does not fit one device."""
+    from mpi4dl_tpu.train import Trainer
+
+    h, w = int(example_shape[0]), int(example_shape[1])
+    if h != w:
+        raise ValueError(
+            f"sharded serving tiles square images, got example {h}x{w}"
+        )
+    cfg = serving_mesh_config(mesh_shape, h, num_classes=num_classes)
+    with conv_overlap_env(conv_overlap):
+        trainer = Trainer(
+            cells, num_spatial_cells=num_spatial_cells, config=cfg,
+            plain_cells=plain_cells, mesh=mesh,
+        )
+    predictor = ShardedPredictor(
+        trainer, params, batch_stats, example_shape,
+        dtype=dtype, conv_overlap=conv_overlap,
+    )
+    return ServingEngine.from_predictor(predictor, **engine_kw)
+
+
+def synthetic_sharded_engine(
+    mesh_shape: Sequence[int],
+    image_size: int = 32,
+    depth: int = 8,
+    num_classes: int = 10,
+    spatial_cells: int = 3,
+    calib_batches: int = 1,
+    conv_overlap: "str | None" = None,
+    seed: int = 0,
+    **engine_kw,
+) -> ServingEngine:
+    """Zero-artifact sharded engine: a spatial ResNet-v1 front (depth
+    6n+2) calibrated on random batches — the sharded twin of the serve
+    CLI's synthetic single-chip path, and what ``--mesh HxW`` builds."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v1
+    from mpi4dl_tpu.parallel.partition import init_cells
+
+    size = int(image_size)
+    plain = get_resnet_v1(
+        depth=depth, num_classes=num_classes, pool_kernel=size // 4
+    )
+    n_sp = min(int(spatial_cells), len(plain) - 1)
+    cells = get_resnet_v1(
+        depth=depth, num_classes=num_classes, pool_kernel=size // 4,
+        spatial_cells=n_sp,
+    )
+    rng = np.random.default_rng(seed)
+    params = init_cells(
+        plain, jax.random.PRNGKey(seed), jnp.zeros((1, size, size, 3))
+    )
+    cal = [
+        jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)
+        for _ in range(max(1, int(calib_batches)))
+    ]
+    stats = collect_batch_stats(plain, params, cal)
+    return sharded_engine(
+        cells, plain, n_sp, params, stats,
+        example_shape=(size, size, 3), mesh_shape=mesh_shape,
+        conv_overlap=conv_overlap, num_classes=num_classes, **engine_kw,
+    )

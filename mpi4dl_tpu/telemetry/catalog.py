@@ -124,6 +124,20 @@ CATALOG: "dict[str, MetricSpec]" = {
         "1 while the engine's health state is OK, 0 after a watchdog "
         "trip or batcher crash — the scrapeable twin of /healthz.",
     ),
+    "serve_mesh_devices": MetricSpec(
+        "gauge", (),
+        "Devices in the serving forward's mesh: 1 for a single-chip "
+        "replica, tile_h*tile_w for a spatially-sharded one (serve/"
+        "sharded.py) — the shard-for-model-size axis, orthogonal to "
+        "fleet replication.",
+    ),
+    "serve_halo_shifts": MetricSpec(
+        "gauge", (),
+        "Forward halo-shift permutes per pass of the serving forward "
+        "(Trainer.halo_shift_count on the sharded predictor; 0 on a "
+        "single chip) — the partition-math input of the mesh-derived "
+        "hlolint halo-permute window that gates every warmed bucket.",
+    ),
     # -- memory observability (mpi4dl_tpu/telemetry/memory.py) ---------------
     "device_hbm_used_bytes": MetricSpec(
         "gauge", ("device",),
@@ -316,7 +330,8 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Measured fraction of collective time overlapped by concurrent "
         "compute in the latest capture (1.0 = fully hidden; absent when "
         "the capture saw no collectives). The sp-overlap A/B publishes "
-        "it per arm (program=sp2x2_monolithic / sp2x2_decomposed).",
+        "it per arm (program=sp2x2_monolithic / sp2x2_decomposed); the "
+        "serving-sharded A/B under program=serving_sharded_<arm>.",
     ),
     # -- load generator (mpi4dl_tpu/serve/loadgen.py) ------------------------
     "loadgen_requests_total": MetricSpec(

@@ -471,3 +471,62 @@ def test_overlap_series_trended_with_correct_signs(tmp_path):
     assert by_key["sp2x2_overlap.trace_overlap_ratio[decomposed]"][
         "verdict"] == "improved"
     assert cmp["ok"] is False
+
+
+def test_serving_sharded_series_trended_with_correct_signs(tmp_path):
+    """ISSUE CI satellite: the serving_sharded extra's per-arm measured
+    overlap ratio trends with the normal sign (falling fails), the
+    per-arm per-request p99 latency with the INVERTED sign (growing
+    fails), and the per-arm serving throughput with the normal sign."""
+    from mpi4dl_tpu.analysis.bench_history import compare, lower_is_better
+
+    def with_sharded(dec_ratio, dec_p99, dec_rps):
+        r = _result(7.0, 0.5)
+        r["extras"]["serving_sharded"] = {"arms": {
+            "monolithic": {
+                "trace_overlap_ratio": 0.27,
+                "latency_ms": {"p50": 12.0, "p99": 26.0},
+                "throughput_rps": 300.0,
+            },
+            "decomposed": {
+                "trace_overlap_ratio": dec_ratio,
+                "latency_ms": {"p50": 13.0, "p99": dec_p99},
+                "throughput_rps": dec_rps,
+            },
+        }}
+        return r
+
+    s = extract_series(with_sharded(0.58, 24.0, 295.0))
+    assert s["serving_sharded.trace_overlap_ratio[decomposed]"] == 0.58
+    assert s["serving_sharded.latency_p99_ms[decomposed]"] == 24.0
+    assert s["serving_sharded.rps[decomposed]"] == 295.0
+    assert s["serving_sharded.latency_p99_ms[monolithic]"] == 26.0
+    assert lower_is_better("serving_sharded.latency_p99_ms[decomposed]")
+    assert not lower_is_better(
+        "serving_sharded.trace_overlap_ratio[decomposed]"
+    )
+    assert not lower_is_better("serving_sharded.rps[decomposed]")
+
+    # Growing p99 regresses (inverted); falling ratio regresses (normal);
+    # growing rps improves.
+    cmp = compare(
+        [{"path": "a", "n": 1, "rc": 0,
+          "result": with_sharded(0.58, 24.0, 295.0)},
+         {"path": "b", "n": 2, "rc": 0,
+          "result": with_sharded(0.40, 32.0, 340.0)}],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["serving_sharded.latency_p99_ms[decomposed]"][
+        "verdict"] == "regressed"
+    assert by_key["serving_sharded.trace_overlap_ratio[decomposed]"][
+        "verdict"] == "regressed"
+    assert by_key["serving_sharded.rps[decomposed]"]["verdict"] == "improved"
+    assert cmp["ok"] is False
+
+    # CI exit: a round whose sharded p99 grew past tolerance fails.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_sharded(0.58, 24.0, 295.0)),
+        _round(2, 0, with_sharded(0.58, 30.0, 295.0)),  # p99 +25%
+    ])
+    assert main(paths) == 1

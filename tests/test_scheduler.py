@@ -311,12 +311,8 @@ def test_multi_image_split_rejoin_bit_identity(model):
         eng.stop()
     assert got.shape == (6, 10)
     cells, params, stats = model
-    want_4 = np.asarray(eng._compiled[4](eng._params, eng._stats, x[:4]))
-    pad2 = np.zeros((2, SIZE, SIZE, 3), np.float32)
-    want_2 = np.asarray(eng._compiled[2](
-        eng._params, eng._stats, x[4:6]
-    ))
-    del pad2
+    want_4 = np.asarray(eng._predictor.run(eng._compiled[4], x[:4]))
+    want_2 = np.asarray(eng._predictor.run(eng._compiled[2], x[4:6]))
     np.testing.assert_array_equal(got[:4], want_4)
     np.testing.assert_array_equal(got[4:6], want_2)
     # The outer future carries the shared trace identity; every row

@@ -1,0 +1,343 @@
+"""Multi-chip sharded serving (:mod:`mpi4dl_tpu.serve.sharded`) — the
+spatial-parallel forward on the serving hot path.
+
+Covers the ISSUE's tier-1 equivalence suite and gates:
+
+- each sharded bucket's output rows vs the single-chip forward on the
+  CPU mesh, for BOTH overlap arms and a non-square (1×2) mesh — the two
+  arms of one mesh are bit-identical to each other (the PR-9 invariant,
+  now on serving), and sharded-vs-plain agrees at the documented f32
+  reduction-order tolerance (different program → different reduction
+  order, the same boundary every cross-program golden in this repo
+  draws);
+- the mesh-derived hlolint expectations: single-chip engines keep the
+  zero-collectives gate byte-for-byte, sharded engines flip to the
+  partition-math halo-permute window off ``Trainer.halo_shift_count``,
+  and every warmed bucket's HLO sits EXACTLY at the counted forward
+  shifts (forward-only program — no backward doubling);
+- a ``memory_guard`` refusal drill on a sharded bucket (per-chip share
+  vs limit, reasons in ``stats()``);
+- the end-to-end acceptance: a 2×2-sharded engine AOT-warms, lints
+  clean, and serves a closed-loop load with zero deadline misses
+  through the unchanged batcher/scheduler stack.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.analysis.rules import Expectations
+from mpi4dl_tpu.evaluate import collect_batch_stats, make_predict
+from mpi4dl_tpu.models.resnet import get_resnet_v1
+from mpi4dl_tpu.parallel.partition import init_cells
+from mpi4dl_tpu.serve import ServingEngine, SingleChipPredictor
+from mpi4dl_tpu.serve.sharded import (
+    ShardedPredictor,
+    parse_mesh,
+    serving_mesh_config,
+    sharded_engine,
+)
+
+SIZE = 16
+DEPTH = 8
+N_SP = 2
+
+
+@pytest.fixture(scope="module")
+def model():
+    """Calibrated spatial-ResNet triple: spatial cells (first N_SP
+    flagged), plain twin (identical param/BN structure), params, pooled
+    BN stats — the input of both the sharded and the single-chip
+    engine, so every comparison below shares one set of weights."""
+    plain = get_resnet_v1(depth=DEPTH, num_classes=10, pool_kernel=SIZE // 4)
+    cells = get_resnet_v1(
+        depth=DEPTH, num_classes=10, pool_kernel=SIZE // 4,
+        spatial_cells=N_SP,
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        plain, jax.random.PRNGKey(0), jnp.zeros((1, SIZE, SIZE, 3))
+    )
+    cal = [jnp.asarray(rng.standard_normal((4, SIZE, SIZE, 3)), jnp.float32)]
+    stats = collect_batch_stats(plain, params, cal)
+    return cells, plain, params, stats
+
+
+def _sharded(model, mesh_shape=(2, 2), conv_overlap=None, **kw):
+    cells, plain, params, stats = model
+    kw.setdefault("buckets", (1, 4))
+    kw.setdefault("default_deadline_s", 60.0)
+    kw.setdefault("watchdog_factor", None)
+    kw.setdefault("memory_monitor", False)
+    return sharded_engine(
+        cells, plain, N_SP, params, stats,
+        example_shape=(SIZE, SIZE, 3), mesh_shape=mesh_shape,
+        conv_overlap=conv_overlap, **kw,
+    )
+
+
+def _examples(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+        for _ in range(n)
+    ]
+
+
+def _golden(model, xs):
+    _, plain, params, stats = model
+    pred = make_predict(plain)
+    full = np.stack(xs)
+    return np.asarray(pred(params, stats, full))
+
+
+# -- mesh-derived lint expectations (ISSUE satellite) -------------------------
+
+
+def test_lint_expectations_mesh_derived(model):
+    """Both derivations of the engine's lint gate: a single-chip
+    predictor derives EXACTLY the former hardcoded
+    ``Expectations(single_chip=True)`` (byte-for-byte — no field
+    drifts), a sharded predictor derives the partition-math halo window
+    (tile grid + counted forward shifts) with single_chip OFF."""
+    cells, plain, params, stats = model
+    single = SingleChipPredictor(
+        plain, params, stats, (SIZE, SIZE, 3), jnp.float32
+    )
+    assert dataclasses.asdict(single.expectations()) == dataclasses.asdict(
+        Expectations(single_chip=True)
+    )
+    assert single.mesh_shape == (1, 1) and single.num_devices == 1
+    assert single.halo_shifts() == 0
+
+    from mpi4dl_tpu.train import Trainer
+
+    cfg = serving_mesh_config((2, 2), SIZE)
+    trainer = Trainer(
+        cells, num_spatial_cells=N_SP, config=cfg, plain_cells=plain
+    )
+    sharded = ShardedPredictor(trainer, params, stats, (SIZE, SIZE, 3))
+    exp = sharded.expectations()
+    assert exp.single_chip is False
+    assert exp.tile_shape == (2, 2)
+    assert exp.halo_shifts == trainer.halo_shift_count(
+        sharded.params, (1, SIZE, SIZE, 3)
+    ) > 0
+    assert sharded.num_devices == 4
+
+
+def test_parse_mesh_and_config_validation():
+    assert parse_mesh("2x2") == (2, 2)
+    assert parse_mesh("1x2") == (1, 2)
+    with pytest.raises(ValueError, match="HxW"):
+        parse_mesh("four")
+    assert serving_mesh_config((1, 2), SIZE).slice_method == "vertical"
+    assert serving_mesh_config((2, 1), SIZE).slice_method == "horizontal"
+    assert serving_mesh_config((2, 2), SIZE).slice_method == "square"
+    with pytest.raises(ValueError, match="single-chip"):
+        serving_mesh_config((1, 1), SIZE)
+    with pytest.raises(ValueError, match="unsupported mesh"):
+        serving_mesh_config((2, 4), SIZE)
+
+
+# -- tier-1 equivalence suite (ISSUE satellite) -------------------------------
+
+
+def test_sharded_bucket_rows_match_single_chip_both_arms(model):
+    """Each sharded bucket's output rows vs the single-chip forward, for
+    both overlap arms on the 2×2 mesh: the arms are bit-identical to
+    EACH OTHER (same mesh, different schedule), and both match the
+    plain forward at the f32 reduction-order tolerance."""
+    mono = _sharded(model, (2, 2), conv_overlap="monolithic")
+    dec = _sharded(model, (2, 2), conv_overlap="decomposed")
+    xs = _examples(4)
+    golden = _golden(model, xs)
+    try:
+        for bucket in mono.buckets:
+            batch = np.stack(xs[:bucket])
+            got_m = np.asarray(
+                mono._predictor.run(mono._compiled[bucket], batch)
+            )
+            got_d = np.asarray(
+                dec._predictor.run(dec._compiled[bucket], batch)
+            )
+            # PR-9 invariant on the serving forward: the decomposition
+            # changes the schedule, never the numbers.
+            np.testing.assert_array_equal(got_m, got_d)
+            np.testing.assert_allclose(got_m, golden[:bucket], atol=1e-5)
+        # The two arms derive the SAME permute inventory (halo_exchange
+        # runs once per windowed op either way).
+        assert (
+            mono._predictor.halo_shifts() == dec._predictor.halo_shifts()
+        )
+    finally:
+        mono.stop()
+        dec.stop()
+
+
+def test_sharded_equivalence_non_square_mesh(model):
+    """The 1×2 (vertical-slice) mesh: W splits across 2 chips, H stays
+    whole — same rows as the plain forward."""
+    eng = _sharded(model, (1, 2), buckets=(2,))
+    xs = _examples(2, seed=3)
+    golden = _golden(model, xs)
+    try:
+        assert eng.mesh_shape == (1, 2)
+        got = np.asarray(eng._predictor.run(eng._compiled[2], np.stack(xs)))
+        np.testing.assert_allclose(got, golden, atol=1e-5)
+        rep = eng.lint_report(bucket=2)
+        assert rep.ok, rep.findings
+    finally:
+        eng.stop()
+
+
+# -- halo-window lint gate ----------------------------------------------------
+
+
+def test_every_sharded_bucket_lints_at_exact_halo_window(model):
+    """Every warmed bucket's HLO passes the mesh-derived lint with zero
+    errors, and the compiled permute inventory sits EXACTLY at the
+    counted forward halo shifts — a forward-only program has no
+    backward re-shifts, so the partition-math floor is also the
+    ceiling. Zero stray resharding: no all-to-all at any bucket."""
+    eng = _sharded(model, (2, 2))
+    try:
+        shifts = eng._predictor.halo_shifts()
+        assert shifts > 0
+        for bucket in eng.buckets:
+            rep = eng.lint_report(bucket=bucket)
+            assert rep.ok, rep.findings
+            assert not any(
+                f["severity"] == "error" for f in rep.findings
+            )
+            assert rep.inventory.get("collective-permute", 0) == shifts
+            assert rep.inventory.get("all-to-all", 0) == 0
+        # The scrapeable mesh facts the catalog pins.
+        assert eng.registry.get("serve_mesh_devices").value() == 4
+        assert eng.registry.get("serve_halo_shifts").value() == shifts
+    finally:
+        eng.stop()
+
+
+# -- memory guard on a sharded bucket (ISSUE satellite) -----------------------
+
+
+def test_memory_guard_refuses_unfit_sharded_bucket(model):
+    """The refusal drill on the SHARDED path: with a limit set between
+    the small and the large bucket's per-chip predicted peak, the large
+    bucket is refused at warm-up with the reason in ``stats()`` and the
+    engine degrades to the bucket that fits."""
+    probe = _sharded(model, (2, 2), buckets=(1, 4))
+    peaks = {
+        int(b): v
+        for b, v in probe.memory_view()["bucket_peak_hbm_bytes"].items()
+    }
+    probe.stop()
+    if peaks.get(1) is None or peaks.get(4) is None:
+        pytest.skip("backend reports no compile-time peaks")
+    assert peaks[4] > peaks[1]  # bigger bucket, bigger per-chip share
+    limit = (peaks[1] + peaks[4]) // 2
+
+    eng = _sharded(
+        model, (2, 2), buckets=(1, 4),
+        memory_guard=True, memory_limit_bytes=limit,
+    )
+    try:
+        assert eng.buckets == (1,)  # degraded, not crashed
+        eng.assert_warm()
+        refused = eng.stats()["memory"]["refused_buckets"]
+        assert set(refused) == {"4"}
+        assert refused["4"]["reason"] == "predicted_peak_exceeds_limit"
+        assert refused["4"]["peak_bytes"] == peaks[4]
+        assert refused["4"]["limit_bytes"] == limit
+        # The fitting bucket still serves.
+        x = _examples(1)[0]
+        np.testing.assert_allclose(
+            eng.predict_one(x), _golden(model, [x])[0], atol=1e-5
+        )
+    finally:
+        eng.stop()
+
+
+# -- fleet: a replica claims a device subset (ISSUE tentpole, fleet side) -----
+
+
+def test_worker_mesh_flag_rides_healthz_payload(tmp_path):
+    """A fleet replica spawned with ``--mesh 1x2`` claims a 1×2 device
+    subset, serves the sharded forward over it, and advertises the mesh
+    shape in its ``/healthz`` payload — the router-visible half of
+    "shard for model size, replicate for traffic"."""
+    import json
+    import os
+    import urllib.request
+
+    from mpi4dl_tpu.fleet.replica import ReplicaClient, ReplicaProcess, worker_cmd
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = ReplicaProcess(
+        "r0",
+        worker_cmd(["--image-size", "16", "--max-batch", "2",
+                    "--mesh", "1x2", "--spatial-cells", "2"]),
+        base_dir=str(tmp_path / "fleet"),
+        env=env,
+        log_path=str(tmp_path / "r0.log"),
+    )
+    try:
+        proc.spawn()
+        ports = proc.wait_ready(timeout_s=420.0)
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ports['metrics_port']}/healthz", timeout=10
+        ).read().decode())
+        assert snap["mesh"] == [1, 2]
+        assert snap["healthy"] is True
+        # The sharded replica serves over the worker RPC unchanged.
+        client = ReplicaClient(
+            "r0", f"http://127.0.0.1:{ports['predict_port']}"
+        )
+        logits, payload = client.predict(
+            np.zeros((16, 16, 3), np.float32), trace_id="mesh-smoke-1",
+            deadline_s=60.0, timeout_s=120.0,
+        )
+        assert np.asarray(logits).shape == (10,)
+    finally:
+        proc.terminate()
+
+
+# -- end-to-end acceptance ----------------------------------------------------
+
+
+def test_sharded_engine_serves_closed_loop_with_zero_misses(model):
+    """ISSUE acceptance (CPU-mesh half): the 2×2-sharded engine AOT-warms
+    its buckets, serves a closed-loop load through the UNCHANGED
+    batcher/scheduler stack with zero deadline misses and zero errors,
+    and every served row matches the single-chip forward."""
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    eng = _sharded(model, (2, 2), buckets=(1, 2, 4), max_queue=128)
+    try:
+        eng.assert_warm()
+        eng.start()
+        rep = run_closed_loop(eng, 24, concurrency=6, deadline_s=60.0)
+        assert rep["served"] == 24
+        assert rep["deadline_misses"] == 0
+        assert rep["errors"] == 0
+        s = eng.stats()
+        assert s["mesh"] == [2, 2]
+        assert s["served"] == 24 and s["batches"] >= 1
+        # Result correctness through the live queue path.
+        xs = _examples(3, seed=5)
+        futs = [eng.submit(x) for x in xs]
+        outs = [f.result(timeout=120) for f in futs]
+        golden = _golden(model, xs)
+        for got, want in zip(outs, golden):
+            np.testing.assert_allclose(got, want, atol=1e-5)
+    finally:
+        eng.stop()
