@@ -26,7 +26,9 @@ monolithic-vs-decomposed conv A/B on a CPU-mesh subprocess and embeds both
 arms' measured ``trace_overlap_ratio`` (``BENCH_SP_OVERLAP=0`` disables);
 ``serving_sharded`` runs the same A/B on the serving hot path — a
 2×2-sharded engine under closed-loop load per arm, ratio + per-request
-p99 per arm (``BENCH_SERVING_SHARDED=0`` disables).
+p99 per arm (``BENCH_SERVING_SHARDED=0`` disables); ``pipeline`` runs the
+LP pipeline's schedule A/B — gpipe vs interleaved 1f1b — embedding both
+arms' measured bubble fraction + img/s (``BENCH_PIPELINE=0`` disables).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -768,6 +770,48 @@ def _measure_serving_sharded() -> dict:
     return out
 
 
+def _measure_pipeline() -> dict:
+    """Pipeline schedule A/B extra: the LP pipeline train step under the
+    gpipe AND interleaved-1f1b schedules (``analyze pipeline``), embedding
+    both arms' measured ``pipeline_bubble_fraction`` + img/s in the result
+    line — bench-history trends the bubble per arm with the INVERTED sign
+    (a grown bubble regresses) and img/s with the normal sign. Same
+    subprocess rationale as ``_measure_sp_overlap``: the pipe mesh must
+    exist regardless of the bench headline's backend, and the property
+    under measurement — which stage-switch slots the compiled schedule
+    executes — is backend-independent."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    # trials=1: the bubble is slot-counted off the compiled schedule's
+    # branch executions — deterministic, unlike the wall-clock ratios the
+    # overlap A/Bs pool across interleaved trials — so extra trials only
+    # buy img/s averaging at real CPU cost.
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analyze", "pipeline",
+         "--steps", "3", "--trials", "1", "--require-improvement",
+         "--json", "-"],
+        env=env, capture_output=True, text=True, timeout=900, cwd=repo,
+    )
+    line = next(
+        (ln for ln in reversed(proc.stdout.splitlines())
+         if ln.startswith("{")), None,
+    )
+    if line is None:
+        raise RuntimeError(
+            f"analyze pipeline emitted no JSON (rc={proc.returncode}): "
+            f"{proc.stderr[-300:]}"
+        )
+    out = json.loads(line)
+    out["rc"] = proc.returncode
+    return out
+
+
 def _serving_attribution(trace_dir, lint_report) -> "dict | None":
     """Measured device-time attribution of the serving load run
     (analysis/trace.py over the engine's own ``mpi4dl_serve_batch``
@@ -1197,6 +1241,12 @@ def main():
     if os.environ.get("BENCH_SERVING_SHARDED", "1") != "0":
         run_extra("serving_sharded", _measure_serving_sharded,
                   est_seconds=300.0)
+
+    # Pipeline schedule A/B (CPU-mesh subprocess): gpipe vs interleaved
+    # 1f1b, both arms' measured bubble fraction + img/s per round so
+    # bench-history trends the bubble trajectory per schedule.
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        run_extra("pipeline", _measure_pipeline, est_seconds=180.0)
 
     if which in ("resnet", "all") and not on_cpu:
         def peak_px():

@@ -143,7 +143,10 @@ def extract_series(result: dict) -> "dict[str, float]":
         # measured overlap ratio (falling fails), SP train-step time
         # (growing fails), and — serving arms only — per-request p99
         # latency with the INVERTED sign plus throughput with the
-        # normal sign.
+        # normal sign. The pipeline schedule A/B rides the same shape:
+        # per-arm measured bubble fraction (INVERTED — a grown bubble
+        # regresses) + img/s (normal). Old rounds without the extra
+        # contribute nothing (absent-not-zero).
         arms = entry.get("arms")
         if isinstance(arms, dict):
             for arm, rec in arms.items():
@@ -163,16 +166,22 @@ def extract_series(result: dict) -> "dict[str, float]":
                 rps = rec.get("throughput_rps")
                 if isinstance(rps, (int, float)):
                     out[f"{name}.rps[{arm}]"] = float(rps)
+                bubble = rec.get("bubble_fraction")
+                if isinstance(bubble, (int, float)):
+                    out[f"{name}.bubble_fraction[{arm}]"] = float(bubble)
+                ips = rec.get("img_per_s")
+                if isinstance(ips, (int, float)):
+                    out[f"{name}.img_per_s[{arm}]"] = float(ips)
     return out
 
 
 def lower_is_better(key: str) -> bool:
-    """Memory, latency, step-time, and tail-shape series regress UPWARD:
-    a grown footprint, a slower death-to-replacement, a slower SP train
-    step, or a fatter p99/p50 tail is the failure, a shrunk one the
-    improvement — the inverse of every throughput/capability/
-    overlap-ratio series (``trace_overlap_ratio`` keeps the normal
-    direction: FALLING overlap fails CI)."""
+    """Memory, latency, step-time, tail-shape, and bubble series regress
+    UPWARD: a grown footprint, a slower death-to-replacement, a slower SP
+    train step, a fatter p99/p50 tail, or a grown pipeline bubble is the
+    failure, a shrunk one the improvement — the inverse of every
+    throughput/capability/overlap-ratio series (``trace_overlap_ratio``
+    keeps the normal direction: FALLING overlap fails CI)."""
     return (
         "peak_hbm_bytes" in key
         or ".recovery_s" in key
@@ -180,6 +189,7 @@ def lower_is_better(key: str) -> bool:
         or key.endswith(".tail_p99_p50_ratio")
         or ".sched_tight_p99_ms" in key
         or ".latency_p99_ms" in key
+        or ".bubble_fraction[" in key
     )
 
 

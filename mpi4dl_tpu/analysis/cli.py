@@ -40,7 +40,12 @@ step's halo/compute overlap A/B — monolithic vs decomposed spatial conv
 SERVING hot path — a spatially-sharded ServingEngine under closed-loop
 load per arm, with per-request latency, the mesh-derived lint gate, and
 the bit-identity crosscheck between arms
-(:mod:`mpi4dl_tpu.analysis.serving_overlap`).
+(:mod:`mpi4dl_tpu.analysis.serving_overlap`);
+``python -m mpi4dl_tpu.analyze pipeline`` measures the LP pipeline's
+schedule A/B — gpipe vs interleaved 1f1b — with live per-stage trace
+attribution, the measured bubble fraction cross-checked against the
+schedule model, and the exact stage-permute lint budget
+(:mod:`mpi4dl_tpu.analysis.pipeline_bench`).
 """
 
 from __future__ import annotations
@@ -186,6 +191,14 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.overlap_bench import main as sp_overlap
 
         return sp_overlap(argv[1:])
+    if argv and argv[0] == "pipeline":
+        # Pipeline schedule A/B (gpipe vs interleaved 1f1b): sets up its
+        # own CPU mesh like sp-overlap, measures a live capture per arm
+        # (measured bubble fraction + img/s), lints both programs at the
+        # exact stage-permute budget.
+        from mpi4dl_tpu.analysis.pipeline_bench import main as pipeline_ab
+
+        return pipeline_ab(argv[1:])
     if argv and argv[0] == "serving-sharded":
         # Sharded-serving overlap A/B (monolithic vs decomposed conv on
         # the serving hot path): builds its own CPU tile mesh like

@@ -48,8 +48,12 @@ class Expectations:
     # each is one collective-permute; the backward re-runs the transposed
     # shifts, partially deduped by XLA — hence the [n, 2n] window.
     halo_shifts: int | None = None
-    # Extra permutes legitimately present beyond halo traffic (pipeline
-    # stage shifts); widens the upper bound only.
+    # EXACT permutes legitimately present beyond halo traffic — the
+    # pipeline engine's stage-boundary wire shifts
+    # (PipelineTrainer.stage_permute_count(): fwd scan body + AD
+    # transpose, 2*(n_virtual-1)). Unlike halo traffic these have no
+    # dedupe slack, so the value shifts BOTH window bounds: a pure-LP
+    # pipeline (halo_shifts=0) is gated at exactly this count.
     extra_permutes: int = 0
     # True when the program is expected to have NO spatial/model sharding
     # (pure DP): any permute/gather/scatter then means resharding crept in.
@@ -137,21 +141,24 @@ def _rule_halo_permute_count(ctx: LintContext) -> list[Finding]:
     if exp.halo_shifts is None:
         return []
     actual = ctx.inventory.get("collective-permute", 0)
-    lo = exp.halo_shifts
+    lo = exp.halo_shifts + exp.extra_permutes
     hi = 2 * exp.halo_shifts + exp.extra_permutes
     if lo <= actual <= hi:
         return []
     if actual < lo:
         msg = (
             f"{actual} collective-permutes but partition math derives "
-            f">= {lo} forward halo shifts: halo exchanges were elided or "
-            "moved off the permute path (Pallas DMA halo? wrong mesh?)."
+            f">= {lo} (= {exp.halo_shifts} forward halo shifts"
+            + (f" + a pipeline permute budget of {exp.extra_permutes} "
+               "stage-boundary shifts" if exp.extra_permutes else "")
+            + "): exchanges were elided or moved off the permute path "
+            "(Pallas DMA halo? wrong mesh? a dropped pipeline wire?)."
         )
     else:
         msg = (
             f"{actual} collective-permutes exceed the derived ceiling {hi} "
             f"(= 2 x {exp.halo_shifts} fwd shifts"
-            + (f" + {exp.extra_permutes} pipeline permutes" if
+            + (f" + a pipeline permute budget of {exp.extra_permutes}" if
                exp.extra_permutes else "")
             + "): per-layer halo traffic multiplied (lost XLA fwd/bwd "
             "dedupe, doubled exchanges, or resharding riding the "

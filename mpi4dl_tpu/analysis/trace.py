@@ -454,6 +454,275 @@ def analyze_trace_dir(trace_dir: str, step_name: str = "mpi4dl_capture") -> dict
     return summary
 
 
+# -- pipeline lens -------------------------------------------------------------
+#
+# Per-stage attribution + measured bubble fraction for the scan-over-ticks
+# pipeline engine (mpi4dl_tpu/parallel/pipeline.py). The engine compiles
+# each tick's stage dispatch to ONE `conditional` with S+1 branch
+# computations — branches 0..S-1 are the per-pipe-device stage bodies,
+# branch S is the idle branch a device takes on fill/drain ticks. Joining
+# the compiled module's branch->instruction closure to the trace's op
+# slices gives, per stage: its device seconds (time-weighted) and its
+# executed slot count; the idle branch's count IS the bubble, measured on
+# the real timeline. This is deliberately slot-counted rather than
+# wall-clock-idle: on the CPU test mesh every virtual device multiplexes
+# onto one shared XLAEigen pool, so per-device wall idle is unobservable
+# (measured: summed busy exceeds n_devices x wall) while branch executions
+# are exact. On a real TPU the same join works off the per-device
+# timelines.
+
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_CALLED_RE = re.compile(
+    r"(?:to_apply|calls|branch_computations|body|condition)="
+    r"(?:%?([\w.\-]+)|\{([^}]*)\})"
+)
+
+
+def _called_computations(instr) -> "list[str]":
+    out: list[str] = []
+    for m in _CALLED_RE.finditer(instr.attrs):
+        if m.group(1):
+            out.append(m.group(1))
+        else:
+            out.extend(p.strip().lstrip("%") for p in m.group(2).split(","))
+    return out
+
+
+def _closure_names(module, comp_name: str) -> "set[str]":
+    """All instruction names reachable from ``comp_name`` through
+    to_apply/calls/branch/body/condition references (transitive)."""
+    seen: set[str] = set()
+    names: set[str] = set()
+    todo = [comp_name]
+    while todo:
+        c = todo.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        comp = module.computations.get(c)
+        if comp is None:
+            continue
+        for instr in comp.instructions:
+            names.add(instr.name)
+            todo.extend(_called_computations(instr))
+    return names
+
+
+def stage_switches(hlo_text_or_module, n_stages: int) -> "list[dict]":
+    """The pipeline stage switches of a compiled module: ``conditional``
+    instructions with exactly ``n_stages + 1`` branch computations. For
+    each, the per-branch instruction-name closure with names shared
+    between branches of the same conditional dropped — a slice on a
+    shared name cannot be attributed to one stage. Branch order is stage
+    order (the engine builds the switch as ``[stage_0..stage_{S-1},
+    idle]``; the AD transpose and remat replays keep it)."""
+    from mpi4dl_tpu.analysis.hlo import parse_hlo_text
+
+    module = (
+        hlo_text_or_module
+        if hasattr(hlo_text_or_module, "computations")
+        else parse_hlo_text(hlo_text_or_module)
+    )
+    out = []
+    for comp in module.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode != "conditional":
+                continue
+            m = _BRANCHES_RE.search(instr.attrs)
+            if not m:
+                continue
+            branches = [b.strip().lstrip("%") for b in m.group(1).split(",")]
+            if len(branches) != n_stages + 1:
+                continue
+            closures = [_closure_names(module, b) for b in branches]
+            unique = []
+            for i, cl in enumerate(closures):
+                others: set = set()
+                for j, other in enumerate(closures):
+                    if j != i:
+                        others |= other
+                unique.append(cl - others)
+            out.append({
+                "name": instr.name,
+                "branches": branches,
+                "unique_names": unique,  # [stage_0..stage_{S-1}, idle]
+            })
+    return out
+
+
+def pipeline_attribution(
+    events,
+    hlo_text_or_module,
+    n_stages: int,
+    step_name: str = "mpi4dl_capture",
+    analytic_bubble: "float | None" = None,
+    schedule: "str | None" = None,
+) -> dict:
+    """Join a pipeline capture to its compiled program's stage switches:
+    per-stage device seconds + executed slot counts, the idle branch's
+    slot count, and the fleet ``bubble_fraction`` =
+    ``idle_slots / (idle_slots + active_slots)`` — for the gated GPipe
+    schedule this measures ``(S-1)/(S-1+M)`` on a live run, the number the
+    ROADMAP said nothing measured. Raises :class:`TraceError` when the
+    module has no ``n_stages + 1``-branch conditional (not a pipeline
+    program, or the wrong stage count)."""
+    switches = stage_switches(hlo_text_or_module, n_stages)
+    if not switches:
+        raise TraceError(
+            f"compiled module has no conditional with {n_stages + 1} "
+            "branches — not a PipelineTrainer program, or n_stages does "
+            "not match its pipe depth"
+        )
+    slices = device_slices(events)
+    windows = step_windows(events, step_name)
+    if windows:
+        lo = min(w[0] for w in windows)
+        hi = max(w[1] for w in windows)
+    elif slices:
+        lo = min(ev.start_s for ev in slices)
+        hi = max(ev.end_s for ev in slices)
+    else:
+        lo = hi = 0.0
+    counts: dict = {}
+    durs: dict = {}
+    permute_s = 0.0
+    for ev in slices:
+        mid = (ev.start_s + ev.end_s) / 2
+        if not (lo <= mid < hi):
+            continue
+        counts[ev.name] = counts.get(ev.name, 0) + 1
+        durs[ev.name] = durs.get(ev.name, 0.0) + ev.duration_s
+        if ev.category == "collective" and "collective-permute" in ev.name:
+            permute_s += ev.duration_s
+
+    def branch_count(unique_names) -> int:
+        # Every instruction unique to the branch executes exactly once per
+        # taken branch; the max absorbs instructions the runtime did not
+        # emit slices for (elided/zero-duration thunks undercount).
+        return max((counts.get(n, 0) for n in unique_names), default=0)
+
+    def branch_seconds(unique_names) -> float:
+        return sum(durs.get(n, 0.0) for n in unique_names)
+
+    per_switch = []
+    active_by_stage = [0] * n_stages
+    seconds_by_stage = [0.0] * n_stages
+    idle_slots = 0
+    for sw in switches:
+        active = [branch_count(u) for u in sw["unique_names"][:n_stages]]
+        idle = branch_count(sw["unique_names"][n_stages])
+        for s in range(n_stages):
+            active_by_stage[s] += active[s]
+            seconds_by_stage[s] += branch_seconds(sw["unique_names"][s])
+        idle_slots += idle
+        per_switch.append({
+            "conditional": sw["name"],
+            "active_slots": active,
+            "idle_slots": idle,
+        })
+    active_slots = sum(active_by_stage)
+    total_slots = active_slots + idle_slots
+    bubble = idle_slots / total_slots if total_slots else None
+    # Per-device idle share: each switch runs total/S/n_switches ticks per
+    # device (replication-invariant), so device s idled 1 - active_s*S/total
+    # of its slots.
+    idle_share = [
+        (1.0 - active_by_stage[s] * n_stages / total_slots)
+        if total_slots else None
+        for s in range(n_stages)
+    ]
+    out = {
+        "n_stages": n_stages,
+        "schedule": schedule,
+        "n_steps": len(windows),
+        "n_switches": len(switches),
+        "per_switch": per_switch,
+        "active_slots_by_stage": active_by_stage,
+        "idle_slots": idle_slots,
+        "total_slots": total_slots,
+        "bubble_fraction": bubble,
+        "idle_share_by_stage": idle_share,
+        "stage_device_seconds": seconds_by_stage,
+        "permute_seconds": permute_s,
+    }
+    if analytic_bubble is not None:
+        out["analytic_bubble_fraction"] = float(analytic_bubble)
+    return out
+
+
+def analyze_pipeline_trace_dir(
+    trace_dir: str,
+    hlo_text: str,
+    n_stages: int,
+    step_name: str = "mpi4dl_capture",
+    analytic_bubble: "float | None" = None,
+    schedule: "str | None" = None,
+) -> dict:
+    """Read one capture directory and attribute it through the pipeline
+    lens (:func:`pipeline_attribution`)."""
+    return pipeline_attribution(
+        read_trace_events(trace_dir), hlo_text, n_stages,
+        step_name=step_name, analytic_bubble=analytic_bubble,
+        schedule=schedule,
+    )
+
+
+#: |measured - analytic| beyond ``max(abs, rel * analytic)`` disagrees.
+BUBBLE_TOL_ABS = 0.02
+BUBBLE_TOL_REL = 0.15
+
+
+def crosscheck_bubble(
+    analytic: float,
+    summary: dict,
+    tol_abs: float = BUBBLE_TOL_ABS,
+    tol_rel: float = BUBBLE_TOL_REL,
+) -> "list[Finding]":
+    """The schedule model says the bubble is ``(S-1)/(S-1+M)``; the trace
+    says what fraction of slots the devices actually idled. Disagreement
+    on the same executable is a lint finding (rule
+    ``pipeline-bubble-crosscheck``) — the PR-4 static-vs-measured pattern,
+    now for pipeline bubbles. ``summary`` is a
+    :func:`pipeline_attribution` result."""
+    measured = summary.get("bubble_fraction")
+    rule = "pipeline-bubble-crosscheck"
+    if measured is None:
+        return [Finding(rule, "warn",
+                        "the capture recorded no stage-switch slots at all "
+                        "— wrong program, empty trace, or the idle branch "
+                        "was folded away (the bubble is unmeasurable).")]
+    if abs(measured - analytic) <= max(tol_abs, tol_rel * analytic):
+        return []
+    direction = "above" if measured > analytic else "below"
+    return [Finding(rule, "warn",
+                    f"measured pipeline bubble {measured:.4f} is {direction} "
+                    f"the schedule-model {analytic:.4f} beyond tolerance: "
+                    "the compiled schedule does not execute the idle "
+                    "structure the model predicts (gating regressed, wrong "
+                    "parts/stages, or the capture mixed programs).")]
+
+
+def publish_pipeline_attribution(summary: dict, registry, program: str):
+    """Publish one pipeline-lens summary under the cataloged
+    ``pipeline_*`` gauges (docs/OBSERVABILITY.md), labeled by ``program``
+    so schedule arms coexist in one registry."""
+    from mpi4dl_tpu import telemetry
+
+    if summary.get("bubble_fraction") is not None:
+        telemetry.declare(registry, "pipeline_bubble_fraction").set(
+            summary["bubble_fraction"], program=program
+        )
+    for s, secs in enumerate(summary.get("stage_device_seconds") or []):
+        telemetry.declare(registry, "pipeline_stage_device_seconds").set(
+            secs, program=program, stage=str(s)
+        )
+    if summary.get("img_per_s") is not None:
+        telemetry.declare(registry, "pipeline_img_per_s").set(
+            summary["img_per_s"], program=program
+        )
+    return registry
+
+
 # -- telemetry + static cross-check -------------------------------------------
 
 

@@ -140,7 +140,14 @@ def model_metadata(family: str, image_size: int, **spec) -> dict:
     (``image_size``/``channels``) a restore-time ``init`` needs to build
     the target pytree. ``spec`` holds the family builder's kwargs (depth /
     num_layers / num_filters / num_classes / pool_kernel / layout ...);
-    a ``dtype`` entry may be a dtype object — it is stored by name."""
+    a ``dtype`` entry may be a dtype object — it is stored by name.
+
+    A ``spatial_cells`` entry records the SPATIAL twin's builder arg (how
+    many leading cells run halo-exchanged when the model is sharded over a
+    tile mesh): :func:`rebuild_cells` ignores it — the plain rebuild stays
+    single-chip-clean — while :func:`rebuild_spatial_twin` uses it, which
+    is what lets ``python -m mpi4dl_tpu.serve --ckpt ... --mesh HxW``
+    shard a checkpoint with no side-channel model config."""
     if family not in _MODEL_FAMILIES:
         raise ValueError(
             f"unknown model family {family!r}; expected one of {_MODEL_FAMILIES}"
@@ -150,9 +157,12 @@ def model_metadata(family: str, image_size: int, **spec) -> dict:
     return {"model": {"family": family, "image_size": int(image_size), **spec}}
 
 
-def rebuild_cells(meta: dict) -> list:
+def rebuild_cells(meta: dict, spatial_cells: int | None = None) -> list:
     """Reconstruct the cell list from a :func:`model_metadata` block (the
-    ``meta.json`` of a self-describing checkpoint)."""
+    ``meta.json`` of a self-describing checkpoint). The default rebuilds
+    the PLAIN twin (any stored ``spatial_cells`` is ignored — restored
+    single-chip serving must stay collective-free); pass ``spatial_cells``
+    to build the halo-exchanged spatial variant instead."""
     try:
         spec = dict(meta["model"])
     except KeyError:
@@ -163,6 +173,9 @@ def rebuild_cells(meta: dict) -> list:
     family = spec.pop("family")
     spec.pop("image_size", None)
     spec.pop("channels", None)
+    spec.pop("spatial_cells", None)
+    if spatial_cells:
+        spec["spatial_cells"] = int(spatial_cells)
     if "dtype" in spec:
         spec["dtype"] = jnp.dtype(spec["dtype"])
     if family == "resnet_v1":
@@ -180,6 +193,30 @@ def rebuild_cells(meta: dict) -> list:
     raise ValueError(
         f"unknown model family {family!r}; expected one of {_MODEL_FAMILIES}"
     )
+
+
+def rebuild_spatial_twin(
+    meta: dict, spatial_cells: int | None = None
+) -> tuple:
+    """``(spatial_cells_list, plain_cells_list, n_spatial)`` from a
+    :func:`model_metadata` block — the triple the sharded serving path
+    (:func:`mpi4dl_tpu.serve.sharded.sharded_engine`) consumes. The
+    spatial-cell count comes from the explicit argument, else the
+    checkpoint's stored ``spatial_cells`` builder arg; a checkpoint saved
+    without one refuses loudly (guessing a halo boundary the trainer never
+    validated would silently change which cells exchange halos)."""
+    stored = (meta.get("model") or {}).get("spatial_cells")
+    n_sp = int(spatial_cells) if spatial_cells is not None else stored
+    if not n_sp:
+        raise ValueError(
+            "checkpoint metadata records no spatial_cells builder arg and "
+            "none was given — re-save with model_metadata(..., "
+            "spatial_cells=N) or pass --spatial-cells to shard this "
+            "checkpoint over a mesh"
+        )
+    plain = rebuild_cells(meta)
+    n_sp = min(int(n_sp), len(plain) - 1)
+    return rebuild_cells(meta, spatial_cells=n_sp), plain, n_sp
 
 
 def restore_batch_stats(path_or_dir: str):

@@ -41,7 +41,21 @@ GPipe fill-drain schedule:
   backward grad hop the reference implements by hand);
 - per-stage activation memory is bounded by ``jax.checkpoint`` around each
   stage body (recompute-in-backward; GPipe-standard), which also keeps
-  ``lax.switch`` residuals uniform across branches.
+  ``lax.switch`` residuals uniform across branches;
+- fill/drain ticks whose stage has no valid micro-batch dispatch to a
+  cheap idle branch (switch index ``S``) instead of computing masked
+  garbage — numerically identical, but the schedule's bubble becomes
+  PHYSICAL device idle the trace-attribution lens can measure
+  (``capture_trace_attribution`` → ``pipeline_bubble_fraction``, checked
+  against the analytic ``(S-1)/(S-1+M)``; docs/OBSERVABILITY.md
+  "Pipeline").
+
+``schedule="1f1b"`` swaps the fill-drain for the interleaved
+virtual-stage schedule (Megatron's interleaved-1F1B family): each pipe
+device hosts ``virtual_stages`` non-contiguous model chunks and
+micro-batches ring through ``v*S`` hops, shrinking the bubble to
+``(S-1)/(parts + v*S - 1)`` at the same loss (golden-equal; the AD
+transpose is the reverse-interleaved backward).
 
 GEMS mirror support: ``mirror=True`` places back-phase stage ``s`` on pipe
 device ``S-1-s`` and reverses wire flow — the reference's ``GEMS_INVERSE``
@@ -131,6 +145,19 @@ class PipelineTrainer:
         use :meth:`spatial_cell_count` to build a matching model).
     plain_cells: non-spatial twin for init + shape tracing (identical param
         structure). Required when the model has spatial cells.
+    schedule: ``"gpipe"`` (fill-drain, the default) or ``"1f1b"`` — the
+        interleaved-virtual-stage schedule (Megatron-LM's interleaved 1F1B
+        family, arXiv:2104.04473): each pipe device hosts ``virtual_stages``
+        non-contiguous model chunks (device ``d`` gets virtual stages ``d,
+        S+d, ...``), micro-batches ring through ``v*S`` hops, and the AD
+        transpose of the scan yields the matching reverse-interleaved
+        backward. Non-interleaved 1F1B has the SAME bubble as GPipe at
+        equal (stages, micro-batches) — its win is memory; the interleaved
+        variant is the one that shrinks the bubble, to
+        ``(S-1)/(parts + v*S - 1)`` from GPipe's ``(S-1)/(parts + S - 1)``,
+        which the trace-attribution lens measures on the real timeline.
+    virtual_stages: model chunks per pipe device under ``schedule="1f1b"``
+        (``v`` above, default 2; ignored for gpipe).
     """
 
     def __init__(
@@ -144,7 +171,32 @@ class PipelineTrainer:
         remat: bool = True,
         mirror: bool = False,
         num_spatial_cells: int | None = None,
+        schedule: str = "gpipe",
+        virtual_stages: int = 2,
     ):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}"
+            )
+        if schedule == "1f1b":
+            if mirror:
+                raise ValueError(
+                    "schedule='1f1b' does not compose with the GEMS mirror "
+                    "placement (the interleaved ring already wraps the pipe "
+                    "axis) — use schedule='gpipe' for GEMS"
+                )
+            if int(virtual_stages) < 2:
+                raise ValueError(
+                    "schedule='1f1b' needs virtual_stages >= 2 (v=1 IS "
+                    "gpipe; the bubble shrinks by the interleave depth)"
+                )
+            if config.lp_stages < 2:
+                raise ValueError(
+                    "schedule='1f1b' needs >= 2 pipeline stages — a 1-deep "
+                    "pipe has no bubble to interleave away"
+                )
+        self.schedule = schedule
+        self.v = int(virtual_stages) if schedule == "1f1b" else 1
         if config.spatial_size:
             if config.spatial_size >= config.split_size:
                 raise ValueError(
@@ -208,9 +260,29 @@ class PipelineTrainer:
                 else None
             )
         self.front_cells = self.cells[: self.n_spatial_cells]
-        self.stages = split_cells(back, self.S, back_balance)
+        # n_virtual model chunks ring through the pipe: S contiguous stages
+        # for gpipe, v*S interleaved virtual stages for 1f1b (a user balance
+        # list only applies when it addresses every virtual stage).
+        self.n_virtual = self.v * self.S
+        if self.v > 1 and (back_balance is None or
+                           len(back_balance) != self.n_virtual):
+            back_balance = None
+        if len(back) < self.n_virtual:
+            raise ValueError(
+                f"{len(back)} back-phase cells cannot split into "
+                f"{self.n_virtual} virtual stages (schedule={schedule!r})"
+            )
+        self.stages = split_cells(back, self.n_virtual, back_balance)
         self._build_static_plan()
         self._jit_step = jax.jit(self._train_step, donate_argnums=0)
+
+    def _stages_of_device(self, d: int) -> "list[int]":
+        """Virtual stages hosted by pipe device ``d``: the one stage
+        ``mirror``-mapped for gpipe; the interleaved set ``d, S+d, ...``
+        (Megatron chunk placement) for 1f1b."""
+        if self.v == 1:
+            return [(self.S - 1 - d) if self.mirror else d]
+        return [j * self.S + d for j in range(self.v)]
 
     # -- static planning -----------------------------------------------------
     @staticmethod
@@ -270,7 +342,7 @@ class PipelineTrainer:
         boundary_trees, out_shape = [], None
         for si, stage in enumerate(self.stages):
             x, shapes = trace(stage, x)
-            if si < self.S - 1:
+            if si < self.n_virtual - 1:
                 boundary_trees.append(x)
             else:
                 out_shape = shapes
@@ -286,9 +358,6 @@ class PipelineTrainer:
             _TreeMeta(t, vec_dtype=wire_dtype(t)) for t in boundary_trees
         ]
 
-    def _device_of_stage(self, s: int) -> int:
-        return (self.S - 1 - s) if self.mirror else s
-
     # -- init ----------------------------------------------------------------
     def init_params(self, rng, dtype=jnp.float32):
         """Params = (front_flat, stacked_back [S, MAXP]). Front params are
@@ -302,21 +371,34 @@ class PipelineTrainer:
         per_cell = init_cells(self.plain_cells, rng, x)
         front_tree = per_cell[: self.n_spatial_cells]
         back_per_stage = split_cells(
-            per_cell[self.n_spatial_cells :], self.S, [len(st) for st in self.stages]
+            per_cell[self.n_spatial_cells :],
+            self.n_virtual,
+            [len(st) for st in self.stages],
         )
         self.front_meta = _TreeMeta(front_tree)
         self.param_metas = [_TreeMeta(t) for t in back_per_stage]
-        self.max_p = max(m.size for m in self.param_metas)
         front_flat = self.front_meta.flatten(front_tree)
+        flats = [
+            meta.flatten(tree)
+            for meta, tree in zip(self.param_metas, back_per_stage)
+        ]
+        # Device row d concatenates its hosted virtual stages' flats (one
+        # stage for gpipe — the original layout — v chunks for 1f1b); each
+        # chunk's static (offset, size) within the row lets the switch
+        # branch slice its params without gathers.
+        self._chunk_offsets: list = []
         rows = []
-        for meta, tree in zip(self.param_metas, back_per_stage):
-            flat = meta.flatten(tree)
-            rows.append(jnp.pad(flat, (0, self.max_p - meta.size)))
-        stacked = jnp.stack(rows)  # [S, MAXP]
-        order = [0] * self.S
-        for s in range(self.S):
-            order[self._device_of_stage(s)] = s
-        stacked = stacked[jnp.asarray(order)]
+        for d in range(self.S):
+            offs, off = [], 0
+            for k in self._stages_of_device(d):
+                offs.append((k, off, self.param_metas[k].size))
+                off += self.param_metas[k].size
+            self._chunk_offsets.append(offs)
+            rows.append(jnp.concatenate([flats[k] for k, _, _ in offs]))
+        self.max_p = max(int(r.shape[0]) for r in rows)
+        stacked = jnp.stack(
+            [jnp.pad(r, (0, self.max_p - int(r.shape[0]))) for r in rows]
+        )  # [S, MAXP]
         return (
             jax.device_put(front_flat, NamedSharding(self.mesh, P())),
             jax.device_put(stacked, NamedSharding(self.mesh, P(AXIS_PIPE, None))),
@@ -336,9 +418,16 @@ class PipelineTrainer:
         front_flat, stacked = params
         out = list(self.front_meta.unflatten(jnp.asarray(front_flat)))
         stacked = jnp.asarray(stacked)
-        for s in range(self.S):
-            row = stacked[self._device_of_stage(s)]
-            out.extend(self.param_metas[s].unflatten(row[: self.param_metas[s].size]))
+        where = {
+            k: (d, off, size)
+            for d in range(self.S)
+            for k, off, size in self._chunk_offsets[d]
+        }
+        for k in range(self.n_virtual):
+            d, off, size = where[k]
+            out.extend(
+                self.param_metas[k].unflatten(stacked[d][off : off + size])
+            )
         return out
 
     # -- front phase ---------------------------------------------------------
@@ -418,11 +507,86 @@ class PipelineTrainer:
 
         return branch
 
+    def _idle_branch(self):
+        """Extra switch branch (index ``S``) a device takes on ticks where
+        its stage has no valid micro-batch (fill/drain). Returning zeros is
+        semantically identical to the garbage the ungated schedule computed
+        there (nothing derived from an invalid tick ever reaches a valid
+        prediction, and the masked preds give those paths zero cotangent) —
+        but it makes the GPipe bubble PHYSICAL: an idle device spends no
+        device time, so the trace-attribution lens can measure the
+        fill-drain fraction instead of watching every device burn full
+        compute on micro-batches that don't exist."""
+        def branch(flat_params, wires, x_mb, *tick):
+            del flat_params, x_mb, tick
+            new_wires = tuple(jnp.zeros_like(w) for w in wires)
+            logits = jnp.zeros((self.mb_back, self.num_classes), jnp.float32)
+            return new_wires, logits
+
+        return branch
+
+    def _make_branch_1f1b(self, d: int):
+        """Switch branch for pipe device ``d`` under the interleaved
+        schedule: apply each hosted virtual-stage chunk (``d, S+d, ...``)
+        whose micro-batch ``t - k`` is in range this tick, consuming wire
+        ``k-1`` (front output for ``k == 0``) and emitting wire ``k`` (or
+        logits for the final chunk). Out-of-range chunks take the cheap
+        zero path of a per-chunk ``lax.cond``, so the interleave's partial
+        edge ticks stay as physically idle as gpipe's fill/drain."""
+        chunks = self._chunk_offsets[d]
+        wire_metas = self.wire_metas
+        nv, parts = self.n_virtual, self.parts
+
+        def branch(flat_params, wires, x_mb, t):
+            new_wires = [jnp.zeros_like(w) for w in wires]
+            logits = jnp.zeros((self.mb_back, self.num_classes), jnp.float32)
+            for k, off, size in chunks:
+                stage = self._stage_fn(k)
+                p_k = lax.slice(flat_params, (off,), (off + size,))
+                m = t - k
+                valid = (m >= 0) & (m < parts)
+                inp = (
+                    x_mb if k == 0
+                    else wire_metas[k - 1].unflatten(wires[k - 1])
+                )
+
+                def run(op, _stage=stage, _k=k):
+                    out = _stage(op[0], op[1])
+                    return out if _k < nv - 1 else out.astype(jnp.float32)
+
+                def skip(op, _k=k):
+                    del op
+                    if _k < nv - 1:
+                        meta = wire_metas[_k]
+                        return meta.unflatten(
+                            jnp.zeros((meta.size,), meta.vec_dtype)
+                        )
+                    return jnp.zeros(
+                        (self.mb_back, self.num_classes), jnp.float32
+                    )
+
+                out = lax.cond(valid, run, skip, (p_k, inp))
+                if k < nv - 1:
+                    new_wires[k] = wire_metas[k].flatten(out)
+                else:
+                    logits = out
+            return tuple(new_wires), logits
+
+        return branch
+
     # -- the schedule --------------------------------------------------------
     def _schedule(self, flat, front_out, mirror: bool):
         """Fill-drain over one chunk. Returns ``(preds, stage_of)`` — preds
         valid only on the last stage's devices, callers mask with
-        ``stage_of == S-1``."""
+        ``stage_of == S-1``. Ticks where a device's stage has no valid
+        micro-batch dispatch to the cheap idle branch (index ``S``), so the
+        schedule's bubble shows up as measurable device idle time."""
+        if self.schedule == "1f1b":
+            if mirror:
+                raise ValueError(
+                    "schedule='1f1b' does not support the mirror placement"
+                )
+            return self._schedule_1f1b(flat, front_out)
         S, parts = self.S, self.parts
         pipe_idx = lax.axis_index(AXIS_PIPE)
         stage_of = (S - 1 - pipe_idx) if mirror else pipe_idx
@@ -431,6 +595,7 @@ class PipelineTrainer:
             return (S - 1 - s) if mirror else s
 
         branches = [self._make_branch(s) for s in range(S)]
+        branches.append(self._idle_branch())
         wires0 = tuple(
             jnp.zeros((m.size,), m.vec_dtype) for m in self.wire_metas
         )
@@ -441,9 +606,12 @@ class PipelineTrainer:
             wires, preds = carry
             m0 = jnp.clip(t, 0, parts - 1)
             x_mb = jax.tree.map(lambda a: a[m0], front_out)
-            new_wires, logits = lax.switch(stage_of, branches, flat, wires, x_mb)
             m = t - stage_of
-            valid_last = (stage_of == S - 1) & (m >= 0) & (m < parts)
+            valid = (m >= 0) & (m < parts)
+            new_wires, logits = lax.switch(
+                jnp.where(valid, stage_of, S), branches, flat, wires, x_mb
+            )
+            valid_last = (stage_of == S - 1) & valid
             mc = jnp.clip(m, 0, parts - 1)
             preds = jnp.where(
                 valid_last,
@@ -457,6 +625,148 @@ class PipelineTrainer:
 
         (_, preds), _ = lax.scan(tick, (wires0, preds0), jnp.arange(parts + S - 1))
         return preds, stage_of
+
+    def _schedule_1f1b(self, flat, front_out):
+        """Interleaved schedule: micro-batches ring through ``v*S`` virtual
+        stages (wire ``k`` hops device ``k%S -> (k+1)%S``, wrapping at the
+        chunk boundary), one tick per hop, ``parts + v*S - 1`` ticks. Each
+        device is busy for ``parts + (v-1)*S`` of them, so the fill/drain
+        idle stays ``S-1`` ticks per device while the tick count grows —
+        bubble ``(S-1)/(parts + v*S - 1)``, strictly below gpipe's
+        ``(S-1)/(parts + S - 1)``. The AD transpose of this scan is the
+        reverse-interleaved backward with the same occupancy."""
+        S, parts, nv = self.S, self.parts, self.n_virtual
+        stage_of = lax.axis_index(AXIS_PIPE)
+        branches = [self._make_branch_1f1b(d) for d in range(S)]
+        branches.append(self._idle_branch())
+        wires0 = tuple(
+            jnp.zeros((m.size,), m.vec_dtype) for m in self.wire_metas
+        )
+        preds0 = jnp.zeros((parts, self.mb_back, self.num_classes), jnp.float32)
+        perm = [[(k % S, (k + 1) % S)] for k in range(nv - 1)]
+
+        def tick(carry, t):
+            wires, preds = carry
+            m0 = jnp.clip(t, 0, parts - 1)
+            x_mb = jax.tree.map(lambda a: a[m0], front_out)
+            # Device d's hosted chunks cover micro-batches over the
+            # contiguous tick span [d, d + (v-1)S + parts - 1]; outside it
+            # the device takes the idle branch (inner conds handle the
+            # per-chunk holes of a short pipeline, parts < S).
+            active = (t >= stage_of) & (
+                t <= stage_of + (self.v - 1) * S + parts - 1
+            )
+            new_wires, logits = lax.switch(
+                jnp.where(active, stage_of, S), branches, flat, wires, x_mb, t
+            )
+            m = t - (nv - 1)
+            valid_last = (stage_of == S - 1) & (m >= 0) & (m < parts)
+            mc = jnp.clip(m, 0, parts - 1)
+            preds = jnp.where(
+                valid_last,
+                lax.dynamic_update_index_in_dim(preds, logits, mc, 0),
+                preds,
+            )
+            sent = tuple(
+                lax.ppermute(w, AXIS_PIPE, pr)
+                for pr, w in zip(perm, new_wires)
+            )
+            return (sent, preds), None
+
+        (_, preds), _ = lax.scan(
+            tick, (wires0, preds0), jnp.arange(parts + nv - 1)
+        )
+        return preds, stage_of
+
+    # -- pipeline observability ----------------------------------------------
+    def analytic_bubble_fraction(self) -> float:
+        """The schedule-model bubble the measured one is cross-checked
+        against: GPipe fill-drain ``(S-1)/(S-1+M)`` (the ROADMAP's open
+        number), interleaved 1F1B ``(S-1)/(M + v*S - 1)`` (per-device idle
+        stays ``S-1`` ticks of a longer, busier tick count)."""
+        S, M = self.S, self.parts
+        if self.schedule == "1f1b":
+            return (S - 1) / (M + self.n_virtual - 1)
+        return (S - 1) / (S - 1 + M)
+
+    def stage_permute_count(self) -> int:
+        """EXACT stage-boundary ``collective-permute`` count of the
+        compiled train step, beyond halo traffic: one per wire in the
+        forward scan body plus its AD-transpose twin — ``2*(n_virtual-1)``
+        (the scan executes them T times, the static inventory counts the
+        body once). This is the value hlolint's
+        ``Expectations.extra_permutes`` pins the permute window with."""
+        return 2 * (self.n_virtual - 1)
+
+    def capture_trace_attribution(
+        self,
+        state,
+        x,
+        y,
+        steps: int = 3,
+        logdir: "str | None" = None,
+        registry=None,
+        program: "str | None" = None,
+        hlo_text: "str | None" = None,
+    ):
+        """Capture an XProf trace of ``steps`` live pipeline train steps
+        and attribute device time (:mod:`mpi4dl_tpu.analysis.trace`) — the
+        standard compute/collective/transfer/host-gap report plus the
+        PIPELINE lens (``summary["pipeline"]``): per-stage device seconds,
+        per-stage/idle slot occupancy counted from the compiled program's
+        stage-switch branches, and the measured ``bubble_fraction``
+        cross-checked against :meth:`analytic_bubble_fraction`. With a
+        ``registry``, publishes the cataloged ``trace_*`` AND
+        ``pipeline_*`` gauges under ``program`` (default
+        ``pipeline_<schedule>``).
+
+        Returns ``(state, summary)`` — the state advances by ``steps``
+        real optimizer updates."""
+        from mpi4dl_tpu import profiling
+        from mpi4dl_tpu.analysis.trace import (
+            analyze_pipeline_trace_dir,
+            publish_pipeline_attribution,
+        )
+
+        program = program or f"pipeline_{self.schedule}"
+        box = {"state": state}
+
+        def one_step(i):
+            del i
+            box["state"], metrics = self.train_step(box["state"], x, y)
+            return metrics["loss"]
+
+        cap = profiling.capture(one_step, steps=steps, logdir=logdir)
+        summary = cap.attribution(registry=registry, program=program)
+        if hlo_text is None:
+            # Callers that already AOT-compiled this step (the pipeline
+            # bench's lint pass, tests) pass its as_text() — the AOT path
+            # does not share the jit cache, so this lower+compile is a
+            # real second compile otherwise.
+            hlo_text = (
+                self._jit_step.lower(box["state"], x, y).compile().as_text()
+            )
+        summary["pipeline"] = analyze_pipeline_trace_dir(
+            cap.trace_dir,
+            hlo_text,
+            n_stages=self.S,
+            step_name=cap.step_name,
+            analytic_bubble=self.analytic_bubble_fraction(),
+            schedule=self.schedule,
+        )
+        # Throughput of the captured steps: the pipeline bench's img/s arm
+        # (global batch images flow through the schedule per step).
+        chunks = getattr(self, "chunks", 1)
+        images = chunks * self.config.batch_size
+        mean_wall = sum(cap.step_times_s) / max(1, len(cap.step_times_s))
+        summary["pipeline"]["img_per_s"] = (
+            images / mean_wall if mean_wall > 0 else 0.0
+        )
+        if registry is not None:
+            publish_pipeline_attribution(
+                summary["pipeline"], registry, program=program
+            )
+        return box["state"], summary
 
     def _contributions(self, preds, y, stage_of):
         """Per-device (ce_sum, correct) masked to the last stage — pre-psum."""
@@ -598,6 +908,15 @@ class GemsMasterTrainer(PipelineTrainer):
     for capability/CLI parity and for the mirrored-placement machinery GEMS
     needs, not because bubbles demand it.
     """
+
+    def __init__(self, *args, **kw):
+        if kw.get("schedule", "gpipe") != "gpipe":
+            raise ValueError(
+                "GemsMasterTrainer runs the gpipe schedule: the GEMS pair "
+                "fills bubbles with the mirrored direction, not by "
+                "interleaving virtual stages"
+            )
+        super().__init__(*args, **kw)
 
     @property
     def chunks(self) -> int:

@@ -55,9 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(overlap_decompose: interior hides the halo "
                         "permute; bit-identical outputs); default "
                         "inherits MPI4DL_TPU_CONV_OVERLAP")
-    p.add_argument("--spatial-cells", type=int, default=3,
-                   help="leading cells of the sharded synthetic model "
-                        "that run spatially partitioned (--mesh only)")
+    p.add_argument("--spatial-cells", type=int, default=None,
+                   help="leading cells of the sharded model that run "
+                        "spatially partitioned (--mesh only; default: "
+                        "the checkpoint's stored spatial_cells builder "
+                        "arg, or 3 for the synthetic model)")
     p.add_argument("--max-batch", type=int, default=8,
                    help="largest micro-batch bucket (power of two)")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
@@ -188,7 +190,10 @@ def _sharded_synthetic_engine(args, mesh_shape):
     return synthetic_sharded_engine(
         mesh_shape, image_size=args.image_size,
         depth=args.depth if args.depth != 11 else 8,  # v1 depths are 6n+2
-        num_classes=args.classes, spatial_cells=args.spatial_cells,
+        num_classes=args.classes,
+        spatial_cells=(
+            args.spatial_cells if args.spatial_cells is not None else 3
+        ),
         calib_batches=args.calib_batches, conv_overlap=args.conv_overlap,
         max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
         max_queue=args.max_queue,
@@ -301,11 +306,22 @@ def main(argv=None) -> int:
     )
 
     if args.ckpt and mesh_shape is not None:
-        print("--ckpt with --mesh is not supported yet: the sharded path "
-              "needs the model's spatial twin (docs/SERVING.md)",
-              file=sys.stderr)
-        return 2
-    if args.ckpt:
+        # Checkpoint → sharded serve: the spatial twin's builder args ride
+        # in the checkpoint metadata (model_metadata(spatial_cells=...)),
+        # so the path + mesh is all the config needed.
+        from mpi4dl_tpu.serve.sharded import sharded_engine_from_checkpoint
+
+        engine = sharded_engine_from_checkpoint(
+            args.ckpt, mesh_shape, spatial_cells=args.spatial_cells,
+            conv_overlap=args.conv_overlap,
+            max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
+            max_queue=args.max_queue,
+            default_deadline_s=args.deadline_ms / 1e3,
+            metrics_port=args.metrics_port,
+            telemetry_dir=args.telemetry_dir,
+            **_liveness_kw(args),
+        )
+    elif args.ckpt:
         engine = ServingEngine.from_checkpoint(
             args.ckpt, max_batch=args.max_batch,
             max_wait_s=args.max_wait_ms / 1e3, max_queue=args.max_queue,

@@ -278,6 +278,48 @@ def sharded_engine(
     return ServingEngine.from_predictor(predictor, **engine_kw)
 
 
+def sharded_engine_from_checkpoint(
+    path_or_dir: str,
+    mesh_shape: Sequence[int],
+    spatial_cells: "int | None" = None,
+    conv_overlap: "str | None" = None,
+    **engine_kw,
+) -> ServingEngine:
+    """Spatially-sharded engine from a self-describing checkpoint path
+    alone: the metadata's model block rebuilds BOTH twins (the plain one
+    for params/BN structure, the spatial one for the tile mesh — the
+    ``spatial_cells`` builder arg rides in the checkpoint, see
+    :func:`mpi4dl_tpu.checkpoint.model_metadata`), restored params and
+    calibrated ``batch_stats`` plug into :func:`sharded_engine`
+    unchanged. This is what ``python -m mpi4dl_tpu.serve --ckpt ...
+    --mesh HxW`` builds (previously refused loudly)."""
+    from mpi4dl_tpu.checkpoint import (
+        rebuild_from_checkpoint,
+        rebuild_spatial_twin,
+    )
+
+    cells, state, stats, meta = rebuild_from_checkpoint(path_or_dir)
+    del cells  # the twins below are rebuilt with the spatial split
+    if stats is None:
+        raise ValueError(
+            "checkpoint has no batch_stats.msgpack — calibrate with "
+            "evaluate.collect_batch_stats and save_checkpoint(..., "
+            "batch_stats=...) before serving"
+        )
+    spatial, plain, n_sp = rebuild_spatial_twin(
+        meta, spatial_cells=spatial_cells
+    )
+    spec = meta["model"]
+    size = int(spec["image_size"])
+    engine_kw.setdefault("dtype", spec.get("dtype", "float32"))
+    return sharded_engine(
+        spatial, plain, n_sp, state.params, stats,
+        example_shape=(size, size, spec.get("channels", 3)),
+        mesh_shape=mesh_shape, conv_overlap=conv_overlap,
+        num_classes=int(spec.get("num_classes", 10)), **engine_kw,
+    )
+
+
 def synthetic_sharded_engine(
     mesh_shape: Sequence[int],
     image_size: int = 32,

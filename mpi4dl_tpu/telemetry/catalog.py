@@ -333,6 +333,27 @@ CATALOG: "dict[str, MetricSpec]" = {
         "it per arm (program=sp2x2_monolithic / sp2x2_decomposed); the "
         "serving-sharded A/B under program=serving_sharded_<arm>.",
     ),
+    # -- pipeline lens (mpi4dl_tpu/analysis/trace.py, parallel/pipeline.py) --
+    "pipeline_bubble_fraction": MetricSpec(
+        "gauge", ("program",),
+        "Measured fill/drain bubble of the latest pipeline capture: idle "
+        "stage-switch slots / all slots, joined from the compiled "
+        "program's branch closures to the real trace (gpipe model "
+        "(S-1)/(S-1+M); the pipeline bench publishes one per schedule "
+        "arm, program=pipeline_gpipe / pipeline_1f1b).",
+    ),
+    "pipeline_stage_device_seconds": MetricSpec(
+        "gauge", ("program", "stage"),
+        "Device seconds attributed to each pipe stage's switch branch "
+        "(forward + AD-transpose backward) in the latest pipeline "
+        "capture — the per-stage/per-device split of the step's device "
+        "time.",
+    ),
+    "pipeline_img_per_s": MetricSpec(
+        "gauge", ("program",),
+        "Images/sec through the pipeline schedule during the latest "
+        "capture (global batch images per mean captured step wall).",
+    ),
     # -- load generator (mpi4dl_tpu/serve/loadgen.py) ------------------------
     "loadgen_requests_total": MetricSpec(
         "counter", ("outcome",),

@@ -473,6 +473,69 @@ def test_overlap_series_trended_with_correct_signs(tmp_path):
     assert cmp["ok"] is False
 
 
+def test_pipeline_series_trended_with_correct_signs(tmp_path):
+    """ISSUE 14 CI satellite: the pipeline extra's per-arm measured
+    bubble fraction trends with the INVERTED sign (a grown bubble fails
+    CI) and the per-arm img/s with the normal sign; rounds from before
+    the extra existed contribute nothing (absent-not-zero)."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def with_pipeline(fb_bubble, fb_ips):
+        r = _result(7.0, 0.5)
+        r["extras"]["pipeline"] = {"arms": {
+            "gpipe": {"bubble_fraction": 0.2, "img_per_s": 5.6,
+                      "analytic_bubble_fraction": 0.2},
+            "1f1b": {"bubble_fraction": fb_bubble, "img_per_s": fb_ips,
+                     "analytic_bubble_fraction": 0.1429},
+        }, "bubble_improved": fb_bubble < 0.2}
+        return r
+
+    s = extract_series(with_pipeline(0.143, 4.8))
+    assert s["pipeline.bubble_fraction[gpipe]"] == 0.2
+    assert s["pipeline.bubble_fraction[1f1b]"] == 0.143
+    assert s["pipeline.img_per_s[1f1b]"] == 4.8
+    assert lower_is_better("pipeline.bubble_fraction[1f1b]")
+    assert not lower_is_better("pipeline.img_per_s[1f1b]")
+
+    # Absent-not-zero: an old round without the extra yields no pipeline
+    # keys, and the comparison reaches past it to the last measurement.
+    old = _result(7.0, 0.5)
+    assert not any(k.startswith("pipeline.") for k in extract_series(old))
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_pipeline(0.143, 4.8)),
+        _round(2, 0, old),
+        _round(3, 0, with_pipeline(0.143, 4.8)),
+    ])
+    assert main(paths) == 0
+
+    # A grown 1f1b bubble is a CI-visible regression even at flat img/s.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_pipeline(0.143, 4.8)),
+        _round(2, 0, with_pipeline(0.19, 4.8)),
+    ])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(
+             paths, [with_pipeline(0.143, 4.8), with_pipeline(0.19, 4.8)]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["pipeline.bubble_fraction[1f1b]"]["verdict"] == "regressed"
+    # A dropped img/s is the throughput regression (normal sign).
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_pipeline(0.143, 4.8)),
+        _round(2, 0, with_pipeline(0.143, 3.9)),
+    ])
+    assert main(paths) == 1
+    # A shrunk bubble is the improvement direction.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_pipeline(0.19, 4.8)),
+        _round(2, 0, with_pipeline(0.143, 4.8)),
+    ])
+    assert main(paths) == 0
+
+
 def test_serving_sharded_series_trended_with_correct_signs(tmp_path):
     """ISSUE CI satellite: the serving_sharded extra's per-arm measured
     overlap ratio trends with the normal sign (falling fails), the

@@ -316,6 +316,37 @@ def test_analyze_sp_overlap_cli_decomposed_crosscheck(tmp_path, capsys):
     assert 0.0 <= arm["trace_overlap_ratio"] <= 1.0
 
 
+def test_analyze_pipeline_cli_one_arm(tmp_path, capsys):
+    """ISSUE 14 CI satellite: `python -m mpi4dl_tpu.analyze pipeline` on
+    one schedule arm — a live LP-pipeline capture attributed through the
+    stage-switch lens, the measured bubble cross-checked against the
+    schedule model, and the compiled program linted at the exact
+    stage-permute budget — end-to-end via the analysis CLI's real
+    dispatch (in-process: the 8-virtual-CPU mesh already exists)."""
+    from mpi4dl_tpu.analysis.cli import main
+
+    out_path = tmp_path / "pipeline_ab.json"
+    rc = main([
+        "pipeline", "--schedule", "gpipe", "--steps", "2", "--warmup", "1",
+        "--json", str(out_path),
+    ])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "gpipe:" in err
+    out = json.load(open(out_path))
+    arm = out["arms"]["gpipe"]
+    assert arm["bubble_fraction"] == pytest.approx(
+        arm["analytic_bubble_fraction"], abs=0.02
+    )
+    # Pure-LP program: the permute inventory sits exactly at the
+    # stage-boundary budget and the window rule holds.
+    assert arm["permutes"] == arm["permute_budget"] == 2
+    assert arm["hlolint_errors"] == []
+    assert arm["crosscheck"] == []
+    assert arm["img_per_s"] > 0
+    assert len(arm["stage_device_seconds"]) == 2
+
+
 def test_serve_cli_mesh_sharded_smoke(tmp_path, capsys):
     """ISSUE CI satellite: `python -m mpi4dl_tpu.serve --mesh HxW` — the
     sharded synthetic engine end to end via the serve CLI: warms, serves

@@ -197,6 +197,43 @@ def test_halo_permute_window():
     )
 
 
+def test_pipeline_permute_budget_shifts_window_and_is_named():
+    """ISSUE 14 CI satellite: ``extra_permutes`` is the pipeline engine's
+    EXACT stage-boundary permute budget — it shifts BOTH window bounds
+    (a pure-LP pipeline is gated at exactly the budget), and both the
+    floor and ceiling messages name the budget so a finding reads as
+    "the pipeline wires changed", not as mystery halo math."""
+    # OVERLAPPED has exactly 1 collective-permute. Budget 1, zero halo
+    # shifts: window [1, 1] — clean.
+    exact = analyze_hlo_text(
+        OVERLAPPED, expected=Expectations(halo_shifts=0, extra_permutes=1)
+    )
+    assert not any(
+        f["rule"] == "halo-permute-count" for f in exact.findings
+    )
+    # Budget 2 with only 1 permute: the FLOOR trips (a dropped pipeline
+    # wire is as much a bug as a doubled one) and names the budget.
+    dropped = analyze_hlo_text(
+        OVERLAPPED, expected=Expectations(halo_shifts=0, extra_permutes=2)
+    )
+    low = [f for f in dropped.findings if f["rule"] == "halo-permute-count"]
+    assert low and low[0]["severity"] == "error"
+    assert "pipeline permute budget of 2" in low[0]["message"]
+    # Three permutes against a budget of 2: the CEILING names it too.
+    tripled = OVERLAPPED.replace(
+        "ROOT %ar-done.1 = f32[8,128]{1,0} all-reduce-done(f32[8,128]{1,0} %ar-start.1)",
+        "%cp.2 = f32[8,128]{1,0} collective-permute(f32[8,128]{1,0} %fusion.1), channel_id=3, source_target_pairs={{0,1},{1,0}}\n"
+        "  %cp.3 = f32[8,128]{1,0} collective-permute(f32[8,128]{1,0} %fusion.1), channel_id=4, source_target_pairs={{0,1},{1,0}}\n"
+        "  ROOT %ar-done.1 = f32[8,128]{1,0} all-reduce-done(f32[8,128]{1,0} %ar-start.1)",
+    )
+    over = analyze_hlo_text(
+        tripled, expected=Expectations(halo_shifts=0, extra_permutes=2)
+    )
+    high = [f for f in over.findings if f["rule"] == "halo-permute-count"]
+    assert high and high[0]["severity"] == "error"
+    assert "pipeline permute budget of 2" in high[0]["message"]
+
+
 def test_memory_regression_rule():
     mem = {"peak_bytes": 1_100_000, "baseline_bytes": 1_000_000,
            "tolerance": 0.05}

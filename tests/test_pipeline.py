@@ -346,6 +346,49 @@ def test_mirror_pipeline_matches_golden():
     _run_and_compare(trainer)
 
 
+def test_1f1b_pipeline_matches_golden_and_gpipe():
+    """ISSUE 14: the interleaved 1F1B schedule (virtual stages ringing
+    through the pipe, AD-transposed backward) is numerically the SAME
+    training step as GPipe — loss equal per step against a GPipe twin
+    sharing the init, updated params equal at the repo's standard
+    tolerance. The GPipe twin itself is golden-anchored against the
+    single-device model at this exact config
+    (test_lp_pipeline_matches_golden[2]), so equality here IS golden
+    equality without paying a third compile. The schedules may only
+    differ in WHEN work runs (the measured bubble, tests/
+    test_pipeline_lens.py), never in what it computes."""
+    cfg = ParallelConfig(
+        batch_size=4, parts=2, split_size=2, spatial_size=0, image_size=32
+    )
+    cells = get_resnet_v1(depth=8)
+    trainer = PipelineTrainer(cells, cfg, schedule="1f1b", virtual_stages=2)
+    assert trainer.n_virtual == 4
+    assert len(trainer.wire_metas) == 3  # v*S - 1 ring boundaries
+    gpipe = PipelineTrainer(cells, cfg)  # same PRNG init below
+
+    state = trainer.init(jax.random.PRNGKey(0))
+    g_state = gpipe.init(jax.random.PRNGKey(0))
+    for i in range(2):
+        x, y = _batch(4, 32, seed=i)
+        xs, ys = trainer.shard_batch(x, y)
+        state, metrics = trainer.train_step(state, xs, ys)
+        g_state, g_metrics = gpipe.train_step(g_state, xs, ys)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(g_metrics["loss"]), rtol=1e-6,
+            err_msg=f"1f1b loss diverged from gpipe at step {i}",
+        )
+        np.testing.assert_allclose(
+            float(metrics["accuracy"]), float(g_metrics["accuracy"]),
+            rtol=1e-6,
+        )
+    got = jax.tree.map(np.asarray, trainer.unstack_params(state.params))
+    want = jax.tree.map(np.asarray, gpipe.unstack_params(g_state.params))
+    jax.tree.map(
+        lambda u, v: np.testing.assert_allclose(u, v, rtol=2e-4, atol=1e-5),
+        got, want,
+    )
+
+
 # jax 0.4.x cannot differentiate the GEMS schedule's shard_map at all:
 # with check_vma/check_rep=False its transpose rule trips an internal
 # _SpecError on the scalar loss outputs, and the check_rep=True rewrite

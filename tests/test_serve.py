@@ -462,25 +462,36 @@ def amoeba_engine():
 def test_loadgen_dynamic_batching_beats_serial(amoeba_engine):
     """ISSUE acceptance: at high offered load (closed loop, 96 clients ≫
     the 32-bucket), throughput ≥2x the batch-size-1 serial baseline, zero
-    deadline misses, and the report carries p50/p90/p99. The serial side
-    is the noisy one on a 1-core CI box (2.2-2.8x at PR 2; the shared
-    box has since drifted to ~1.95-2.3x per trial with the serial
-    denominator swinging ±12%), so the ratio gets two re-measures before
-    failing — the bound itself stays 2.0."""
+    deadline misses, and the report carries p50/p90/p99.
+
+    De-flake rationale (ISSUE 14 satellite): the SERIAL side is the noisy
+    half of the ratio on the shared 1-core CI box — measured per-trial
+    spread of ±12% on the bs-1 denominator (PR 10), while the batched
+    numerator holds within a few percent, and the ratio grazed 1.99x once
+    purely on a slow serial sample. So each attempt anchors the
+    denominator at the MEDIAN of 3 serial measurements (a single fast or
+    slow outlier cannot move a median-of-3), keeps the two re-measures for
+    whole-box noise bursts, and the bound itself stays 2.0 — the claim
+    "dynamic batching at least doubles serial throughput" is unchanged,
+    only the estimator of serial throughput got robust."""
+    from mpi4dl_tpu.profiling import percentiles
     from mpi4dl_tpu.serve.loadgen import run_closed_loop, serial_throughput
 
     eng = amoeba_engine
     eng.start()
     best = 0.0
     for _ in range(3):
-        serial = serial_throughput(eng, 32)
+        serial_rps = percentiles(
+            [serial_throughput(eng, 32)["throughput_rps"] for _ in range(3)],
+            (50,),
+        )["p50"]
         rep = run_closed_loop(eng, 384, concurrency=96, deadline_s=30.0)
         assert rep["served"] == 384  # everything admitted was served...
         assert rep["deadline_misses"] == 0  # ...inside its deadline
         assert rep["errors"] == 0
         assert {"p50", "p90", "p99"} <= set(rep["latency_s"])
         assert json.loads(json.dumps(rep))  # report is JSON-serializable
-        best = max(best, rep["throughput_rps"] / serial["throughput_rps"])
+        best = max(best, rep["throughput_rps"] / serial_rps)
         if best >= 2.0:
             break
     assert best >= 2.0, f"dynamic batching speedup {best:.2f}x < 2x"

@@ -341,3 +341,119 @@ def test_sharded_engine_serves_closed_loop_with_zero_misses(model):
             np.testing.assert_allclose(got, want, atol=1e-5)
     finally:
         eng.stop()
+
+
+# -- checkpoint -> sharded serve (ISSUE 14 satellite) --------------------------
+
+
+def test_checkpoint_round_trips_to_sharded_engine(model, tmp_path):
+    """ROADMAP PR-13 follow-on (b): a checkpoint whose metadata records
+    the spatial twin's builder args (``model_metadata(...,
+    spatial_cells=N)``) round-trips to a spatially-sharded engine from
+    the path + mesh alone — and the restored sharded rows match the same
+    checkpoint's single-chip predictions at the documented f32
+    reduction-order tolerance (tile-local convs are a different program).
+    Without the stored arg (and no override) the sharded path still
+    refuses loudly; the plain rebuild keeps ignoring the arg so the
+    single-chip restore stays collective-free."""
+    from mpi4dl_tpu.checkpoint import (
+        model_metadata,
+        rebuild_cells,
+        save_checkpoint,
+    )
+    from mpi4dl_tpu.serve.sharded import sharded_engine_from_checkpoint
+    from mpi4dl_tpu.train import TrainState, make_optimizer
+
+    _, plain, params, stats = model
+    state = TrainState(
+        params=params, opt_state=make_optimizer().init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+    meta = model_metadata(
+        "resnet_v1", image_size=SIZE, depth=DEPTH, num_classes=10,
+        pool_kernel=SIZE // 4, spatial_cells=N_SP,
+    )
+    save_checkpoint(str(tmp_path), state, metadata=meta, batch_stats=stats)
+
+    # The plain rebuild ignores spatial_cells: no halo cells, and the
+    # single-chip engine from the same path lints at zero collectives.
+    plain_again = rebuild_cells(meta)
+    assert not any(
+        getattr(c, "spatial", False) for c in plain_again
+    )
+    single = ServingEngine.from_checkpoint(
+        str(tmp_path), buckets=(2,), watchdog_factor=None,
+        memory_monitor=False,
+    )
+    xs = _examples(2, seed=7)
+    batch = np.stack(xs)
+    try:
+        assert single.lint_report().ok
+        ref = np.asarray(single._predictor.run(single._compiled[2], batch))
+    finally:
+        single.stop()
+
+    eng = sharded_engine_from_checkpoint(
+        str(tmp_path), (2, 2), buckets=(2,), watchdog_factor=None,
+        memory_monitor=False,
+    )
+    try:
+        assert eng.mesh_shape == (2, 2)
+        got = np.asarray(eng._predictor.run(eng._compiled[2], batch))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+        assert eng.lint_report().ok  # mesh-derived halo window
+    finally:
+        eng.stop()
+
+    # No stored spatial_cells and no override: loud refusal...
+    bare = model_metadata(
+        "resnet_v1", image_size=SIZE, depth=DEPTH, num_classes=10,
+        pool_kernel=SIZE // 4,
+    )
+    bare_dir = tmp_path / "bare"
+    save_checkpoint(str(bare_dir), state, metadata=bare, batch_stats=stats)
+    with pytest.raises(ValueError, match="spatial_cells"):
+        sharded_engine_from_checkpoint(str(bare_dir), (2, 2))
+    # ...while an explicit --spatial-cells-style override still works.
+    eng2 = sharded_engine_from_checkpoint(
+        str(bare_dir), (2, 2), spatial_cells=N_SP, buckets=(2,),
+        watchdog_factor=None, memory_monitor=False,
+    )
+    try:
+        assert eng2.mesh_shape == (2, 2)
+    finally:
+        eng2.stop()
+
+
+def test_serve_cli_ckpt_with_mesh(model, tmp_path, capsys):
+    """ISSUE 14 satellite (CLI surface): ``python -m mpi4dl_tpu.serve
+    --ckpt ... --mesh 2x2`` — previously a loud refusal — restores the
+    spatial twin from the checkpoint metadata, warms, serves, and passes
+    the mesh-derived lint gate."""
+    from mpi4dl_tpu.checkpoint import model_metadata, save_checkpoint
+    from mpi4dl_tpu.serve.__main__ import main
+    from mpi4dl_tpu.train import TrainState, make_optimizer
+
+    _, plain, params, stats = model
+    state = TrainState(
+        params=params, opt_state=make_optimizer().init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+    meta = model_metadata(
+        "resnet_v1", image_size=SIZE, depth=DEPTH, num_classes=10,
+        pool_kernel=SIZE // 4, spatial_cells=N_SP,
+    )
+    save_checkpoint(str(tmp_path), state, metadata=meta, batch_stats=stats)
+    out_path = tmp_path / "serve_ckpt_mesh.json"
+    rc = main([
+        "--ckpt", str(tmp_path), "--mesh", "2x2", "--max-batch", "2",
+        "--requests", "6", "--concurrency", "3", "--serial", "0",
+        "--lint", "--json", str(out_path),
+    ])
+    assert rc == 0
+    import json as _json
+
+    rep = _json.load(open(out_path))
+    assert rep["mesh"] == [2, 2]
+    assert rep["loadgen"]["served"] == 6
+    assert rep["lint"]["ok"]

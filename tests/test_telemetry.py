@@ -651,6 +651,18 @@ def full_stack(tmp_path_factory):
         summary["collective"]["overlap_ratio"] = 0.0
     publish_attribution(summary, reg, program="unit")
 
+    # Pipeline-lens publisher (analysis/trace.py): a canned summary keeps
+    # the catalog==runtime pin honest without compiling a pipeline
+    # trainer here — the live capture behind these numbers is exercised
+    # by tests/test_pipeline_lens.py (the fleet.declare_metrics pattern).
+    from mpi4dl_tpu.analysis.trace import publish_pipeline_attribution
+
+    publish_pipeline_attribution(
+        {"bubble_fraction": 0.2, "stage_device_seconds": [0.5, 0.7],
+         "img_per_s": 7.9},
+        reg, program="pipeline_gpipe",
+    )
+
     events = telemetry.read_events(
         os.path.join(tdir, os.listdir(tdir)[0])
     )
