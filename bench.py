@@ -28,7 +28,11 @@ arms' measured ``trace_overlap_ratio`` (``BENCH_SP_OVERLAP=0`` disables);
 2×2-sharded engine under closed-loop load per arm, ratio + per-request
 p99 per arm (``BENCH_SERVING_SHARDED=0`` disables); ``pipeline`` runs the
 LP pipeline's schedule A/B — gpipe vs interleaved 1f1b — embedding both
-arms' measured bubble fraction + img/s (``BENCH_PIPELINE=0`` disables).
+arms' measured bubble fraction + img/s (``BENCH_PIPELINE=0`` disables);
+``tiled_gigapixel`` walks the largest image ONE chip serves through the
+halo-correct tile stream (serve/tiled.py) and measures fixed-size request
+latency + the tile/stitch split (``BENCH_TILED=0`` disables;
+``BENCH_TILED_PX``/``BENCH_TILED_TILE``/``BENCH_TILED_WALK`` scale it).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -812,6 +816,110 @@ def _measure_pipeline() -> dict:
     return out
 
 
+def _measure_tiled_gigapixel() -> dict:
+    """Gigapixel tiled-inference extra (serve/tiled.py): (a) a peak
+    feasible px WALK — the largest square image one chip serves through
+    the halo-correct tile stream, each success recorded with the tile
+    executable's compile-time peak so the round file shows bounded-not-
+    full-image memory; (b) per-request latency at a FIXED large size
+    under a small closed loop, with the tile-count/stitch breakdown.
+    bench-history trends ``tiled_gigapixel.peak_px`` (normal sign — a
+    shrunk capability regresses) and ``tiled_gigapixel.latency_p99_ms``
+    (INVERTED — slower gigapixel requests regress). Sizes scale by
+    backend: CPU walks 256→512 so the extra stays in budget; a TPU round
+    starts at 8192 (past the single-chip monolithic wall) by default.
+    ``BENCH_TILED_PX``/``BENCH_TILED_TILE``/``BENCH_TILED_WALK``
+    override."""
+    import jax
+    import numpy as np
+
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.serve.tiled import synthetic_tiled_engine
+
+    on_cpu = jax.default_backend() == "cpu"
+    fixed_px = int(
+        os.environ.get("BENCH_TILED_PX", "256" if on_cpu else "8192")
+    )
+    tile = int(
+        os.environ.get("BENCH_TILED_TILE", str(max(64, fixed_px // 4)))
+    )
+    walk_steps = int(os.environ.get("BENCH_TILED_WALK", "1"))
+    engine_kw = dict(
+        tile=tile, max_queue=8, calib_batches=1,
+        default_deadline_s=1200.0,
+    )
+    entry = {
+        "unit": "square image side, one chip, tiled stream",
+        "tile": tile,
+        "walk": [],
+        "peak_px": None,
+    }
+
+    # (a) Peak feasible px walk: double from the fixed size; each
+    # success is recorded immediately (the next, larger, attempt is
+    # expected to eventually fail — on TPU with RESOURCE_EXHAUSTED at
+    # the head, on CPU only by budget).
+    px = fixed_px
+    for _ in range(walk_steps + 1):
+        t0 = time.time()
+        step = {"px": px}
+        try:
+            eng = synthetic_tiled_engine(px, **engine_kw)
+            try:
+                eng.start()
+                fut = eng.submit(
+                    np.zeros((px, px, 3), np.float32), deadline_s=1200.0
+                )
+                fut.result(timeout=1200.0)
+                tile_e = eng.memory_ledger.get("serve_tiled", bucket=1)
+                head_e = eng.memory_ledger.get("serve_tiled_head")
+                step.update(
+                    serve_s=round(time.time() - t0, 2),
+                    tile_peak_hbm_bytes=(
+                        tile_e.get("peak_bytes") if tile_e else None
+                    ),
+                    head_peak_hbm_bytes=(
+                        head_e.get("peak_bytes") if head_e else None
+                    ),
+                )
+                entry["peak_px"] = px
+            finally:
+                eng.stop()
+        except Exception as e:  # noqa: BLE001 — the walk's whole point
+            # is to find the failure edge without losing the peak
+            step["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+            entry["walk"].append(step)
+            break
+        entry["walk"].append(step)
+        px *= 2
+
+    # (b) Latency at the fixed size: a small closed loop (gigapixel
+    # traffic is low-rps by nature; the tail percentiles and the
+    # tile/stitch split are the serving numbers that matter).
+    eng = synthetic_tiled_engine(fixed_px, **engine_kw)
+    try:
+        eng.start()
+        rep = run_closed_loop(
+            eng, 6 if on_cpu else 4, concurrency=2, deadline_s=1200.0
+        )
+    finally:
+        eng.stop()
+    lint = eng.lint_report()
+    entry.update(
+        image_px=fixed_px,
+        latency_ms={
+            k: round(v * 1e3, 1)
+            for k, v in rep["latency_s"].items() if v is not None
+        },
+        served=rep["served"],
+        errors=rep["errors"],
+        deadline_misses=rep["deadline_misses"],
+        tiled=rep["engine"].get("tiled"),
+        lint_ok=lint.ok,
+    )
+    return entry
+
+
 def _serving_attribution(trace_dir, lint_report) -> "dict | None":
     """Measured device-time attribution of the serving load run
     (analysis/trace.py over the engine's own ``mpi4dl_serve_batch``
@@ -1247,6 +1355,13 @@ def main():
     # bench-history trends the bubble trajectory per schedule.
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
         run_extra("pipeline", _measure_pipeline, est_seconds=180.0)
+
+    # Gigapixel tiled inference (serve/tiled.py): peak feasible px walk
+    # through the one-chip tile stream + latency at a fixed large size —
+    # bench-history trends peak_px (normal) and p99 latency (inverted).
+    if os.environ.get("BENCH_TILED", "1") != "0":
+        run_extra("tiled_gigapixel", _measure_tiled_gigapixel,
+                  est_seconds=240.0)
 
     if which in ("resnet", "all") and not on_cpu:
         def peak_px():

@@ -99,6 +99,20 @@ def extract_series(result: dict) -> "dict[str, float]":
         peak = entry.get("peak_trainable_px_per_chip")
         if isinstance(peak, (int, float)):
             out[f"{name}.peak_px"] = float(peak)
+        # Tiled-gigapixel extra (shape-gated on peak_px so the serving
+        # extra's own latency_ms — deliberately trended only as the
+        # p99/p50 RATIO, absolute latency being box noise — stays out):
+        # the capability point (largest image the one-chip tile stream
+        # served this round) trends with the normal sign, the fixed-size
+        # per-request p99 with the INVERTED one.
+        peak = entry.get("peak_px")
+        if isinstance(peak, (int, float)):
+            out[f"{name}.peak_px"] = float(peak)
+            lat = entry.get("latency_ms")
+            if isinstance(lat, dict) and isinstance(
+                lat.get("p99"), (int, float)
+            ):
+                out[f"{name}.latency_p99_ms"] = float(lat["p99"])
         by_bucket = entry.get("peak_hbm_bytes_by_bucket")
         if isinstance(by_bucket, dict):
             for b, v in by_bucket.items():

@@ -53,6 +53,7 @@ from mpi4dl_tpu.analysis.memory import (
 )
 
 DEFAULT_PX_LADDER = "256,512,1024,1536,2048,3072,4096,6144,8192"
+DEFAULT_TILE_LADDER = "64,128,256,512,1024,2048,4096"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -110,13 +111,28 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("none", "cell", "sqrt", "scan", "scan2",
                             "scanlog", "scanq", "scan_save", "cell_save",
                             "group_save"))
-    p.add_argument("--bisect", choices=("px", "bucket"), default=None,
+    p.add_argument("--bisect", choices=("px", "bucket", "tile"),
+                   default=None,
                    help="binary-search the largest feasible value on the "
-                        "candidate ladder (needs a limit)")
+                        "candidate ladder (needs a limit). 'tile' "
+                        "answers the gigapixel question: the largest "
+                        "tile core whose tile-streaming executables "
+                        "(section window + stitched-feature head, "
+                        "serve/tiled.py) both fit the chip at --size")
     p.add_argument("--px-candidates", default=DEFAULT_PX_LADDER,
                    help="comma-separated px ladder for --bisect px")
     p.add_argument("--max-bucket", type=int, default=64,
                    help="largest power-of-two bucket for --bisect bucket")
+    p.add_argument("--tile", type=int, default=None,
+                   help="serve: predict the TILED forward's peaks at "
+                        "this tile core instead of the monolithic "
+                        "forward (a stride-aligned px count)")
+    p.add_argument("--tile-candidates", default=DEFAULT_TILE_LADDER,
+                   help="comma-separated stride-aligned tile-core "
+                        "ladder for --bisect tile")
+    p.add_argument("--tile-bucket", type=int, default=8,
+                   help="TILE bucket the tiled section executable is "
+                        "lowered at (the runtime's largest tile batch)")
     return p
 
 
@@ -233,25 +249,18 @@ def _serve_cells(args, px: int):
     )
 
 
-def predict_serve_peak(cells, px: int, bucket: int, dtype=None) -> "dict | None":
-    """Compile-only peak of the frozen-stats serve forward for one
-    bucket — lowered FULLY abstractly (eval_shape params + batch-stats
-    structure, ShapeDtypeStruct input), so nothing executes and no
-    device array is materialized. The result is bit-identical to
-    ``memory_summary`` of the executable the engine's AOT warm-up
-    builds for the same config (tier-1-asserted)."""
+def _abstract_serve_state(cells, px: int, dtype):
+    """Fully abstract ``(params, batch_stats)`` structures of a cell list
+    at ``px`` — ``jax.eval_shape`` end to end, zero device arrays. The
+    shared substrate of the monolithic and tiled compile-only peaks."""
     import jax
-    import jax.numpy as jnp
 
-    from mpi4dl_tpu.analysis.memory import memory_summary
-    from mpi4dl_tpu.evaluate import _apply_running, stats_unfreeze, _finalize
+    from mpi4dl_tpu.evaluate import stats_unfreeze, _finalize
     from mpi4dl_tpu.ops.layers import bn_stats_mode
     from mpi4dl_tpu.parallel.partition import init_cells
 
-    dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
     cells = tuple(cells)
     x1 = jax.ShapeDtypeStruct((1, px, px, 3), dtype)
-
     params_s = jax.eval_shape(
         lambda k, x: init_cells(list(cells), k, x),
         jax.random.PRNGKey(0), x1,
@@ -266,6 +275,25 @@ def predict_serve_peak(cells, px: int, bucket: int, dtype=None) -> "dict | None"
         return [_finalize(s) for s in stats_unfreeze(out)]
 
     stats_s = jax.eval_shape(collect_one, params_s, x1)
+    return params_s, stats_s
+
+
+def predict_serve_peak(cells, px: int, bucket: int, dtype=None) -> "dict | None":
+    """Compile-only peak of the frozen-stats serve forward for one
+    bucket — lowered FULLY abstractly (eval_shape params + batch-stats
+    structure, ShapeDtypeStruct input), so nothing executes and no
+    device array is materialized. The result is bit-identical to
+    ``memory_summary`` of the executable the engine's AOT warm-up
+    builds for the same config (tier-1-asserted)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.analysis.memory import memory_summary
+    from mpi4dl_tpu.evaluate import _apply_running
+
+    dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    cells = tuple(cells)
+    params_s, stats_s = _abstract_serve_state(cells, px, dtype)
 
     def fwd(p, s, x):
         return _apply_running(cells, p, s, x)
@@ -273,6 +301,46 @@ def predict_serve_peak(cells, px: int, bucket: int, dtype=None) -> "dict | None"
     xb = jax.ShapeDtypeStruct((int(bucket), px, px, 3), dtype)
     compiled = jax.jit(fwd).lower(params_s, stats_s, xb).compile()
     return memory_summary(compiled)
+
+
+def predict_tiled_peak(
+    cells, px: int, tile: int, tile_bucket: int = 8, dtype=None
+) -> "dict | None":
+    """Compile-only peaks of the TILED forward (serve/tiled.py) at one
+    tile core: the section executable at its ``tile_bucket × window ×
+    window`` shape plus the head at the stitched-feature shape — both
+    lowered abstractly, nothing executed. ``peak_bytes`` is the max of
+    the two (both must fit the chip at run time); the per-executable
+    breakdown and the derived geometry ride alongside. This is how
+    "what tile size fits this chip" is answered BEFORE a gigapixel
+    request exists."""
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.analysis.memory import memory_summary
+    from mpi4dl_tpu.evaluate import aot_compile_tiled_predict
+    from mpi4dl_tpu.serve.tiled import tile_geometry
+
+    dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    cells = tuple(cells)
+    params_s, stats_s = _abstract_serve_state(cells, px, dtype)
+    g = tile_geometry(
+        cells, params_s, stats_s, (px, px, 3), tile, dtype=dtype
+    )
+    exe = aot_compile_tiled_predict(
+        cells, params_s, stats_s, g.split,
+        (*g.window_hw, 3), (*g.feat_hw, g.feat_channels),
+        [int(tile_bucket)], dtype=dtype, feature_dtype=g.feat_dtype,
+    )
+    tile_sum = memory_summary(exe["tile"][int(tile_bucket)])
+    head_sum = memory_summary(exe["head"])
+    if tile_sum is None or head_sum is None:
+        return None
+    return {
+        "peak_bytes": max(tile_sum["peak_bytes"], head_sum["peak_bytes"]),
+        "tile_peak_bytes": tile_sum["peak_bytes"],
+        "head_peak_bytes": head_sum["peak_bytes"],
+        "geometry": g.describe(),
+    }
 
 
 def predict_train_peak(args, px: int, batch: int) -> "dict | None":
@@ -303,8 +371,13 @@ def predict_train_peak(args, px: int, batch: int) -> "dict | None":
     return memory_summary(compiled)
 
 
-def _predict(args, px: int, bucket: int) -> "dict | None":
+def _predict(args, px: int, bucket: int, tile: "int | None" = None) -> "dict | None":
     if args.program == "serve":
+        if tile is not None:
+            return predict_tiled_peak(
+                _serve_cells(args, px), px, tile,
+                tile_bucket=args.tile_bucket, dtype=args.dtype,
+            )
         return predict_serve_peak(
             _serve_cells(args, px), px, bucket, dtype=args.dtype
         )
@@ -313,14 +386,24 @@ def _predict(args, px: int, bucket: int) -> "dict | None":
 
 def _bisect(args, limit: int) -> dict:
     """Largest feasible value on the candidate ladder (binary search —
-    peak is monotone in both px and bucket). Every compiled candidate
-    is reported; refusals on RESOURCE_EXHAUSTED (the CPU backend can
-    itself OOM lowering a huge program) count as infeasible."""
+    peak is monotone in px, bucket, and tile core). Every compiled
+    candidate is reported; refusals on RESOURCE_EXHAUSTED (the CPU
+    backend can itself OOM lowering a huge program) count as
+    infeasible. The ``tile`` axis predicts BOTH tiled executables
+    (section window + head) and requires both to fit — when even the
+    smallest tile's head is too big, nothing fits and the exit is 1."""
     from mpi4dl_tpu.telemetry.memory import is_oom_error
 
     if args.bisect == "px":
         ladder = sorted(
             int(v) for v in str(args.px_candidates).split(",") if v.strip()
+        )
+    elif args.bisect == "tile":
+        if args.program != "serve":
+            raise SystemExit("--bisect tile needs --program serve")
+        ladder = sorted(
+            int(v) for v in str(args.tile_candidates).split(",")
+            if v.strip()
         )
     else:
         ladder, b = [], 1
@@ -336,8 +419,9 @@ def _bisect(args, limit: int) -> dict:
         val = ladder[mid]
         px = val if args.bisect == "px" else args.size
         bucket = val if args.bisect == "bucket" else args.bucket
+        tile = val if args.bisect == "tile" else None
         try:
-            summary = _predict(args, px, bucket)
+            summary = _predict(args, px, bucket, tile=tile)
             peak = summary["peak_bytes"] if summary else None
         except Exception as e:  # noqa: BLE001 — a compile that OOMs IS
             if not is_oom_error(e):  # the infeasibility verdict
@@ -345,7 +429,11 @@ def _bisect(args, limit: int) -> dict:
             summary, peak = None, None
         verdict = feasibility(peak, limit, args.fit_margin)
         fits = bool(verdict["fits"]) if peak is not None else False
-        candidates.append({args.bisect: val, **verdict, "fits": fits})
+        entry = {args.bisect: val, **verdict, "fits": fits}
+        if summary and "tile_peak_bytes" in summary:
+            entry["tile_peak_bytes"] = summary["tile_peak_bytes"]
+            entry["head_peak_bytes"] = summary["head_peak_bytes"]
+        candidates.append(entry)
         if fits:
             best = val
             lo = mid + 1
@@ -372,6 +460,10 @@ def _compile_mode(args) -> int:
     }
     if args.program == "serve":
         config["bucket"] = args.bucket
+        if args.tile is not None or args.bisect == "tile":
+            config["tile_bucket"] = args.tile_bucket
+        if args.tile is not None:
+            config["tile"] = args.tile
     else:
         config.update(batch=args.batch, remat=args.remat, dp=args.dp,
                       spatial_parts=args.spatial_parts)
@@ -404,12 +496,15 @@ def _compile_mode(args) -> int:
         )
         return 0 if plan["ok"] else 1
 
-    summary = _predict(args, args.size, args.bucket)
+    tile = args.tile if args.program == "serve" else None
+    summary = _predict(args, args.size, args.bucket, tile=tile)
     peak = summary["peak_bytes"] if summary else None
     verdict = feasibility(peak, limit, args.fit_margin)
     key = (
         f"{args.program}_{args.model}_{args.size}px"
-        + (f"_b{args.bucket}" if args.program == "serve"
+        + (f"_tile{tile}" if tile is not None else "")
+        + (f"_b{args.bucket}" if args.program == "serve" and tile is None
+           else "" if args.program == "serve"
            else f"_bs{args.batch}_{args.remat}")
     )
     plan = {

@@ -184,6 +184,62 @@ def aot_compile_predict(
     return out
 
 
+def aot_compile_tiled_predict(
+    cells: Sequence[Any],
+    params: Sequence[Any],
+    batch_stats,
+    split: int,
+    window_shape: Sequence[int],
+    feature_shape: Sequence[int],
+    tile_buckets: Sequence[int],
+    dtype=jnp.float32,
+    feature_dtype=None,
+) -> dict:
+    """AOT-lower the two halves of the tile-streaming forward
+    (:mod:`mpi4dl_tpu.serve.tiled`): the SPATIAL SECTION (``cells[:split]``
+    — conv/pool stack up to the head, the part that runs per overlap-read
+    tile) once per tile bucket at the fixed ``window_shape``, and the HEAD
+    (``cells[split:]`` — the post-gather global section) once at the full
+    stitched ``feature_shape``. Returns ``{"tile": {bucket: compiled},
+    "head": compiled}``.
+
+    The section executable is the hot loop: a gigapixel request streams
+    its tiles through THIS one fixed-shape program, so peak HBM is
+    bounded by the window, never the image. Same no-surprise-JIT contract
+    as :func:`aot_compile_predict` — compilation happens here, at serving
+    warm-up, and a ``Compiled`` object can never trace again.
+    """
+    cells = tuple(cells)
+    split = int(split)
+    if not 0 < split < len(cells):
+        raise ValueError(
+            f"split must cut the cell list in two, got {split} of "
+            f"{len(cells)} cells"
+        )
+    sec, head = cells[:split], cells[split:]
+    p_sec, p_head = list(params[:split]), list(params[split:])
+    s_sec, s_head = list(batch_stats[:split]), list(batch_stats[split:])
+
+    def sec_fwd(p, s, x):
+        return _apply_running(sec, p, s, x)
+
+    def head_fwd(p, s, x):
+        return _apply_running(head, p, s, x)
+
+    tile = {}
+    for b in sorted({int(b) for b in tile_buckets}):
+        if b < 1:
+            raise ValueError(f"tile bucket sizes must be >= 1, got {b}")
+        xs = jax.ShapeDtypeStruct((b, *tuple(window_shape)), dtype)
+        tile[b] = jax.jit(sec_fwd).lower(p_sec, s_sec, xs).compile()
+    hs = jax.ShapeDtypeStruct(
+        (1, *tuple(feature_shape)),
+        feature_dtype if feature_dtype is not None else dtype,
+    )
+    head_c = jax.jit(head_fwd).lower(p_head, s_head, hs).compile()
+    return {"tile": tile, "head": head_c}
+
+
 def evaluate(
     cells: Sequence[Any], params: Sequence[Any], batch_stats, batches
 ) -> dict:

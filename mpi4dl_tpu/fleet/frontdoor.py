@@ -155,6 +155,10 @@ def _submit_server(router, port: int) -> ThreadingHTTPServer:
                 req = json.loads(self.rfile.read(length).decode())
                 if self.path in ("/submit", "/predict"):
                     self._submit(req)
+                elif self.path == "/predict_tiled":
+                    # Gigapixel passthrough: same RPC/error shapes, the
+                    # router dispatches to the replicas' tiled surface.
+                    self._submit(req, tiled=True)
                 elif self.path == "/replicas":
                     self._replicas(req)
                 else:
@@ -171,7 +175,7 @@ def _submit_server(router, port: int) -> ThreadingHTTPServer:
                 except Exception:  # noqa: BLE001
                     pass
 
-        def _submit(self, req: dict) -> None:
+        def _submit(self, req: dict, tiled: bool = False) -> None:
             x = np.frombuffer(
                 base64.b64decode(req["x_b64"]),
                 dtype=req.get("dtype", "float32"),
@@ -186,6 +190,7 @@ def _submit_server(router, port: int) -> ThreadingHTTPServer:
                 hit = router.fetch_served(
                     req["trace_id"], x,
                     deadline_s=min(req.get("deadline_s") or 5.0, 5.0),
+                    tiled=tiled,
                 )
                 if hit is not None:
                     logits, payload = hit
@@ -199,6 +204,7 @@ def _submit_server(router, port: int) -> ThreadingHTTPServer:
                     deadline_s=req.get("deadline_s"),
                     trace_id=req.get("trace_id"),
                     slo_class=req.get("slo_class"),
+                    tiled=tiled,
                 )
             except QueueFullError as e:
                 self._reply(429, {

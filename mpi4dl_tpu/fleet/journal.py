@@ -57,6 +57,7 @@ class JournalOrphan:
     deadline_wall: float
     slo_class: "str | None"
     router_epoch: int
+    tiled: bool = False  # re-dispatch to /predict_tiled, not /predict
 
     def remaining_s(self, now: "float | None" = None) -> float:
         return self.deadline_wall - (time.time() if now is None else now)
@@ -123,6 +124,7 @@ def scan(path: str, now: "float | None" = None) -> JournalScan:
             deadline_wall=float(ev["deadline_wall"]),
             slo_class=ev.get("slo_class"),
             router_epoch=int(ev.get("router_epoch", 0)),
+            tiled=bool(ev.get("tiled", False)),
         ))
     return JournalScan(
         orphans=orphans, completed=completed, expired=expired,
@@ -161,6 +163,7 @@ class RouterJournal:
         x: np.ndarray,
         deadline_remaining_s: float,
         slo_class: "str | None" = None,
+        tiled: bool = False,
     ) -> None:
         self._append({
             "kind": "accept",
@@ -172,6 +175,7 @@ class RouterJournal:
             "shape": [int(d) for d in x.shape],
             "deadline_wall": time.time() + float(deadline_remaining_s),
             "slo_class": slo_class,
+            "tiled": bool(tiled),
             "router_epoch": self.router_epoch,
         })
 

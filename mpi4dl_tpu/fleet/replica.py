@@ -109,6 +109,7 @@ class ReplicaClient:
         timeout_s: float,
         slo_class: "str | None" = None,
         retried: bool = False,
+        tiled: bool = False,
     ) -> "tuple[np.ndarray, dict]":
         """One blocking predict RPC; returns ``(logits, payload)`` or
         raises one of the typed errors above. ``slo_class`` propagates
@@ -117,7 +118,10 @@ class ReplicaClient:
         ``retried=True`` marks a failover retry whose earlier attempt
         MAY have executed — a front-door router receiving it probes the
         replicas' served-caches before dispatching (duplicate
-        suppression across the router failure domain)."""
+        suppression across the router failure domain). ``tiled=True``
+        targets the worker's gigapixel ``/predict_tiled`` surface
+        (serve/tiled.py) instead of ``/predict`` — same RPC shape, same
+        structured errors, same idempotency cache."""
         payload = {
             "trace_id": trace_id,
             "deadline_s": float(deadline_s),
@@ -131,7 +135,10 @@ class ReplicaClient:
         if retried:
             payload["retried"] = True
         try:
-            out = self._post("/predict", payload, timeout_s)
+            out = self._post(
+                "/predict_tiled" if tiled else "/predict",
+                payload, timeout_s,
+            )
         except urllib.error.HTTPError as e:
             try:
                 err = json.loads(e.read().decode())

@@ -101,11 +101,12 @@ class _Record:
     __slots__ = (
         "x", "submit_t", "deadline", "future", "trace_id", "slo_class",
         "lock", "state", "epoch", "attempts", "history",
-        "first_dispatch_t", "last_error", "replayed",
+        "first_dispatch_t", "last_error", "replayed", "tiled",
+        "rpc_slo_class",
     )
 
     def __init__(self, x, submit_t, deadline, future, trace_id,
-                 slo_class=None):
+                 slo_class=None, tiled=False, rpc_slo_class=None):
         self.x = x
         self.submit_t = submit_t
         self.deadline = deadline
@@ -120,6 +121,15 @@ class _Record:
         self.first_dispatch_t: "float | None" = None
         self.last_error: "Exception | None" = None
         self.replayed = False
+        self.tiled = bool(tiled)
+        # What rides the replica RPC: for plain requests the router's
+        # resolved class (worker engines declare the same classes); for
+        # tiled requests only an EXPLICIT caller class — the tiled
+        # engine has its own class set (default "tiled"), which it
+        # resolves itself when none is sent.
+        self.rpc_slo_class = (
+            rpc_slo_class if tiled else (rpc_slo_class or slo_class)
+        )
 
 
 class _Replica:
@@ -423,6 +433,7 @@ class Router:
         deadline_s: "float | None" = None,
         trace_id: "str | None" = None,
         slo_class: "str | None" = None,
+        tiled: bool = False,
     ):
         """Admit one request; returns a ``Future``. Mirrors
         :meth:`ServingEngine.submit` (queue-full/deadline semantics,
@@ -430,13 +441,18 @@ class Router:
         changes. The class is validated against the router's configured
         classes and rides every replica RPC; under queue pressure the
         burn-rate feedback sheds deprioritized classes HERE, before
-        a doomed request crosses to a replica."""
+        a doomed request crosses to a replica. ``tiled=True`` routes to
+        the replicas' gigapixel ``/predict_tiled`` surface — the image
+        is shape-checked by the replica's tiled engine (its large
+        example shape is a worker-spawn fact the router does not
+        duplicate), everything else (ledger, requeue-on-death, journal
+        replay, idempotency) is identical."""
         from concurrent.futures import Future
 
         from mpi4dl_tpu.serve.engine import QueueFullError
 
         x = np.asarray(x, self._np_dtype)
-        if x.shape != self.example_shape:
+        if not tiled and x.shape != self.example_shape:
             raise ValueError(
                 f"example shape {x.shape} != configured {self.example_shape}"
             )
@@ -465,7 +481,10 @@ class Router:
             trace_id=(
                 str(trace_id) if trace_id else telemetry.new_trace_id("fleet")
             ),
-            slo_class=cls.name,
+            slo_class=cls.name, tiled=tiled,
+            rpc_slo_class=(
+                str(slo_class) if slo_class is not None else None
+            ),
         )
         with self._cond:
             depth = len(self._pending)
@@ -504,7 +523,8 @@ class Router:
             # it, the client's own failover retry covers the request and
             # the replica-side idempotency cache dedupes the overlap.
             self._journal.accept(
-                rec.trace_id, x, deadline_s, slo_class=cls.name
+                rec.trace_id, x, deadline_s, slo_class=cls.name,
+                tiled=tiled,
             )
         with self._lock:
             self._counts["submitted"] += 1
@@ -578,7 +598,8 @@ class Router:
             self._events.close()
 
     def fetch_served(self, trace_id: str, x,
-                     deadline_s: float = 5.0) -> "tuple | None":
+                     deadline_s: float = 5.0,
+                     tiled: bool = False) -> "tuple | None":
         """Duplicate-suppression probe for a RETRIED request (a client
         failing over after a router death cannot know whether its first
         attempt executed): ask each replica's served-cache whether it
@@ -596,7 +617,7 @@ class Router:
                     continue
                 out = rep.client.predict(
                     x, trace_id, deadline_s=deadline_s,
-                    timeout_s=deadline_s + 1.0,
+                    timeout_s=deadline_s + 1.0, tiled=tiled,
                 )
             except Exception:  # noqa: BLE001 — a replica that cannot
                 continue  # vouch (or died holding the cache) proves
@@ -693,12 +714,14 @@ class Router:
             x=np.asarray(orphan.x, self._np_dtype), submit_t=now,
             deadline=now + remaining, future=Future(),
             trace_id=orphan.trace_id, slo_class=cls_name,
+            tiled=getattr(orphan, "tiled", False),
         )
         rec.replayed = True
         # Re-accept under THIS incarnation's epoch so a second router
         # death replays it again (the scan dedupes by trace id).
         self._journal.accept(
-            rec.trace_id, rec.x, remaining, slo_class=cls_name
+            rec.trace_id, rec.x, remaining, slo_class=cls_name,
+            tiled=rec.tiled,
         )
         self._m_replays.inc(outcome="redispatched")
         with self._lock:
@@ -799,7 +822,7 @@ class Router:
         try:
             logits, payload = rep.client.predict(
                 rec.x, rec.trace_id, deadline_s=remaining, timeout_s=timeout,
-                slo_class=rec.slo_class,
+                slo_class=rec.rpc_slo_class, tiled=rec.tiled,
             )
         except ReplicaQueueFull as e:
             outcome, error = "queue_full", e

@@ -82,6 +82,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spatial-cells", type=int, default=2,
                    help="leading spatial cells of the sharded synthetic "
                         "model (--mesh only)")
+    p.add_argument("--tiled", default=None, metavar="HxW",
+                   help="additionally serve POST /predict_tiled: a "
+                        "second engine streaming halo-correct overlap-"
+                        "read tiles of HxW images through one chip at "
+                        "bounded memory (serve/tiled.py), with its own "
+                        "'tiled' SLO class — the gigapixel surface the "
+                        "router's tiled passthrough targets")
+    p.add_argument("--tile", type=int, default=None,
+                   help="tiled core extent in px (--tiled only; "
+                        "default: a quarter of the image)")
+    p.add_argument("--tile-batch", type=int, default=1,
+                   help="largest power-of-two TILE bucket of the tiled "
+                        "forward (--tiled only; 1 = the exact default)")
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--max-batch", type=int, default=2)
     p.add_argument("--max-wait-ms", type=float, default=2.0)
@@ -219,7 +232,7 @@ class _ServedCache:
 
 
 def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
-                    port: int) -> ThreadingHTTPServer:
+                    port: int, tiled_engine=None) -> ThreadingHTTPServer:
     from mpi4dl_tpu.serve.engine import (
         DeadlineExceededError,
         DrainedError,
@@ -243,6 +256,16 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                 req = json.loads(self.rfile.read(length).decode())
                 if self.path == "/predict":
                     self._predict(req)
+                elif self.path == "/predict_tiled":
+                    # The gigapixel surface: same RPC shape + idempotency
+                    # cache, answered by the tile-streaming engine.
+                    if tiled_engine is None:
+                        self._reply(404, {
+                            "ok": False,
+                            "error": "no tiled engine (spawn with --tiled)",
+                        })
+                    else:
+                        self._predict(req, engine=tiled_engine)
                 elif self.path == "/served":
                     self._reply(200, {
                         "ok": True,
@@ -266,7 +289,7 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                 except Exception:  # noqa: BLE001
                     pass
 
-        def _predict(self, req: dict) -> None:
+        def _predict(self, req: dict, engine=engine) -> None:
             if draining.is_set():
                 self._reply(503, {"ok": False, "error": "draining"})
                 return
@@ -433,6 +456,26 @@ def main(argv=None) -> int:
             **engine_kw,
         )
 
+    tiled_engine = None
+    if args.tiled:
+        # The gigapixel surface rides a SECOND engine (its own scheduler
+        # classes, buckets, and registry — counters of 60-second tiled
+        # requests must not fold into the interactive engine's series;
+        # its geometry/latency facts surface on /healthz).
+        from mpi4dl_tpu.serve.__main__ import _parse_tiled_size
+        from mpi4dl_tpu.serve.tiled import synthetic_tiled_engine
+
+        tiled_engine = synthetic_tiled_engine(
+            _parse_tiled_size(args.tiled), tile=args.tile,
+            depth=8, num_classes=args.classes,
+            tile_batch=args.tile_batch,
+            max_queue=args.max_queue,
+            default_deadline_s=max(args.default_deadline_s, 120.0),
+            watchdog_factor=args.watchdog_factor or None,
+            watchdog_min_timeout_s=args.watchdog_min_timeout,
+        )
+        tiled_engine.start()
+
     chaos = _ChaosState()
     # Chaos seam: the wedge gate runs INSIDE the batcher thread's
     # dispatch, upstream of the real one — a wedged batcher with live
@@ -459,6 +502,11 @@ def main(argv=None) -> int:
         # tile_h x tile_w = a sharded forward. Routers/operators read
         # shard-for-model-size here, orthogonal to replica count.
         snap["mesh"] = list(engine.mesh_shape)
+        if tiled_engine is not None:
+            # The gigapixel surface this replica additionally serves:
+            # routers and operators read the geometry (and live request/
+            # tile totals) off the same one-endpoint scrape.
+            snap["tiled"] = tiled_engine.stats().get("tiled")
         return snap
 
     metrics_server = telemetry.MetricsServer(
@@ -468,7 +516,9 @@ def main(argv=None) -> int:
         debug=engine._debugz,
         alerts=engine.slo.state if engine.slo is not None else None,
     )
-    predict_httpd = _predict_server(engine, chaos, draining, args.port)
+    predict_httpd = _predict_server(
+        engine, chaos, draining, args.port, tiled_engine=tiled_engine
+    )
 
     heartbeat = None
     hb_path = elastic.heartbeat_path_from_env()
@@ -506,6 +556,8 @@ def main(argv=None) -> int:
     # Graceful drain: admissions already answer 503; serve what's
     # queued, then tear down.
     engine.stop(drain=True)
+    if tiled_engine is not None:
+        tiled_engine.stop(drain=True)
     predict_httpd.shutdown()
     metrics_server.close()
     if heartbeat is not None:

@@ -76,6 +76,47 @@ def conv_overlap_impl() -> str:
     return impl
 
 
+# Trace-time recorders of PLAIN (non-spatial) windowed-op geometry — the
+# count_halo_shifts pattern applied to receptive-field math instead of
+# permute counting: tracing a model section under record_windowed_ops()
+# (e.g. with jax.eval_shape — no device work) yields every conv/pool's
+# kernel/stride/padding and input extent in call order, which is exactly
+# the partition-math input the tiled-inference margin derives from
+# (serve/tiled.py: margin = cumulative receptive-field growth, the
+# single-device analogue of the spatial halo the exchange ops carry).
+_WINDOWED_OP_RECORDERS: "list[list]" = []
+
+
+@contextlib.contextmanager
+def record_windowed_ops():
+    """Record plain windowed-op geometry issued while tracing the
+    enclosed region. Yields a list of dicts (kind/kernel/strides/
+    padding/input_hw, in call order); packed-layout ops record
+    ``kind="packed"`` so consumers that cannot reason about the packed
+    column layout can refuse loudly instead of mis-stitching."""
+    box: list = []
+    _WINDOWED_OP_RECORDERS.append(box)
+    try:
+        yield box
+    finally:
+        _WINDOWED_OP_RECORDERS.remove(box)
+
+
+def _record_windowed_op(kind, x, kh, kw, sh, sw, ph, pw, **extra) -> None:
+    if not _WINDOWED_OP_RECORDERS:
+        return
+    rec = {
+        "kind": kind,
+        "kernel": (int(kh), int(kw)),
+        "strides": (int(sh), int(sw)),
+        "padding": (int(ph), int(pw)),
+        "input_hw": (int(x.shape[1]), int(x.shape[2])),
+        **extra,
+    }
+    for box in _WINDOWED_OP_RECORDERS:
+        box.append(rec)
+
+
 def _strip_bounds(n: int, k: int, s: int, p: int) -> tuple[int, int, int]:
     """Per-dim split of a spatial op's output rows into halo-dependent
     boundary strips and a halo-free interior.
@@ -398,6 +439,9 @@ class Conv2d(nn.Module):
                 )
             if self.spatial:
                 _check_window_coverage(kh, kw, sh, sw, ph, pw)
+            # Packed columns fold W into C: the recorded extents cannot be
+            # interpreted as image rows/cols, so geometry consumers refuse.
+            _record_windowed_op("packed", x, kh, kw, sh, sw, ph, pw)
             from mpi4dl_tpu.ops.packed import PackedConv
 
             return PackedConv(
@@ -424,6 +468,7 @@ class Conv2d(nn.Module):
         )
 
         if not self.spatial:
+            _record_windowed_op("conv", x, kh, kw, sh, sw, ph, pw)
             return conv(x)
 
         if self.exchange:
@@ -708,6 +753,11 @@ class Pool(nn.Module):
             raise ValueError(f"unknown pool kind {self.kind!r}")
 
         if not exchanged:
+            _record_windowed_op(
+                "pool", x, kh, kw, sh, sw, ph, pw,
+                pool_kind=self.kind,
+                count_include_pad=self.count_include_pad,
+            )
             return apply_pool(x, pad)
 
         xe = halo_exchange(x, ph, pw, AXIS_TILE_H, AXIS_TILE_W, fill_value=fill)

@@ -60,3 +60,11 @@ from mpi4dl_tpu.serve.sharded import (  # noqa: F401
     sharded_engine,
     synthetic_sharded_engine,
 )
+from mpi4dl_tpu.serve.tiled import (  # noqa: F401
+    TiledPredictor,
+    TileGeometry,
+    synthetic_tiled_engine,
+    tile_geometry,
+    tiled_engine,
+    tiled_engine_from_checkpoint,
+)

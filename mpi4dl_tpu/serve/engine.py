@@ -165,6 +165,10 @@ class _Request:
     # resolve into, and this row's index in it.
     join: "_Join | None" = None
     row: int = 0
+    # Tiled-forward facts of the dispatch that served this request
+    # (tile count, stitch/stream seconds — serve/tiled.py), riding the
+    # serve.request span event so `analyze tail`/trace-export see them.
+    tiled: "dict | None" = None
 
 
 class _Join:
@@ -478,6 +482,16 @@ class ServingEngine:
         )
         self.refused_buckets: "dict[int, dict]" = {}
         telemetry.declare(self.registry, "oom_reports_total")
+        # Predictor observability seam: a predictor that wants the
+        # engine's ledger/registry/event log (the tiled predictor records
+        # its tile + head executables and publishes tiled_* series) binds
+        # them here, BEFORE warm-up compiles anything.
+        bind = getattr(self._predictor, "bind_telemetry", None)
+        if bind is not None:
+            bind(
+                registry=self.registry, ledger=self.memory_ledger,
+                events=self._events,
+            )
 
         # AOT warm-up: compile every bucket now, then run each once so the
         # first real request pays neither a compile nor a first-exec setup.
@@ -525,11 +539,17 @@ class ServingEngine:
             )
         self._buckets = tuple(sorted(self._compiled))
         self._max_batch = max(self._buckets)
+        # Predictors that publish per-run stats (tiled) must not count
+        # the warm-up zeros runs as served traffic.
+        if hasattr(self._predictor, "warming"):
+            self._predictor.warming = True
         for b in self._buckets:
             z = np.zeros((b, *self.example_shape), self._np_dtype)
             t0 = time.perf_counter()
             np.asarray(self._predictor.run(self._compiled[b], z))
             self.warm_latency_s[b] = time.perf_counter() - t0
+        if hasattr(self._predictor, "warming"):
+            self._predictor.warming = False
         self.assert_warm()
 
         # The continuous scheduler (or the fifo baseline): per-class
@@ -994,6 +1014,11 @@ class ServingEngine:
         out["warm_latency_s"] = dict(self.warm_latency_s)
         out["healthy"] = self.health.healthy
         out["memory"] = self.memory_view()
+        run_stats = getattr(self._predictor, "run_stats", None)
+        if run_stats is not None:
+            # Tiled predictor: geometry + per-request tile/stitch facts
+            # (the loadgen report's `tiled` block reads this).
+            out["tiled"] = run_stats()
         return out
 
     def memory_view(self) -> dict:
@@ -1215,9 +1240,14 @@ class ServingEngine:
                 staged = self._predictor.stage(batch)  # async H2D
                 out = self._predictor.run(self._compiled[bucket], staged)
         staged_t = time.monotonic()
+        # Tiled predictors record per-run facts (tile count, stitch/
+        # stream seconds) — attach them so this batch's requests carry
+        # them into their span events and tail samples.
+        tiled_facts = getattr(self._predictor, "last_run", None)
         for r in reqs:
             r.staged_t = staged_t
             r.dispatch_seq = seq
+            r.tiled = tiled_facts
         with self._lock:
             self._bucket_dispatches[bucket] = (
                 self._bucket_dispatches.get(bucket, 0) + 1
@@ -1388,13 +1418,15 @@ class ServingEngine:
                 attribution=self.last_attribution,
             )
         if self.flight.enabled or self._events.enabled:
+            attrs = {"outcome": outcome, "bucket": bucket,
+                     "batch_size": batch_size,
+                     "e2e_latency_s": end_t - r.submit_t,
+                     "slo_class": r.slo_class,
+                     "pid": os.getpid(), "role": "engine"}
+            if r.tiled is not None:
+                attrs["tiled"] = dict(r.tiled)
             ev = telemetry.span_event(
-                "serve.request", r.trace_id, spans,
-                attrs={"outcome": outcome, "bucket": bucket,
-                       "batch_size": batch_size,
-                       "e2e_latency_s": end_t - r.submit_t,
-                       "slo_class": r.slo_class,
-                       "pid": os.getpid(), "role": "engine"},
+                "serve.request", r.trace_id, spans, attrs=attrs,
             )
             self.flight.record(ev)
             if self._events.enabled:

@@ -138,6 +138,36 @@ CATALOG: "dict[str, MetricSpec]" = {
         "single chip) — the partition-math input of the mesh-derived "
         "hlolint halo-permute window that gates every warmed bucket.",
     ),
+    # -- gigapixel tiled inference (mpi4dl_tpu/serve/tiled.py) ---------------
+    "tiled_tiles_total": MetricSpec(
+        "counter", (),
+        "Overlap-read tile windows streamed through the tiled forward's "
+        "section executable (serve/tiled.py /predict_tiled).",
+    ),
+    "tiled_tile_batches_total": MetricSpec(
+        "counter", ("bucket",),
+        "Tile-batch dispatches of the tiled forward, by tile bucket "
+        "(the power-of-two TILE buckets inside one request — orthogonal "
+        "to the engine's per-image buckets).",
+    ),
+    "tiled_tiles_per_request": MetricSpec(
+        "gauge", (),
+        "Tiles per request of the configured tile geometry "
+        "(grid_h * grid_w — constant per engine, derived from the "
+        "image size, tile core, and receptive-field margin).",
+    ),
+    "tiled_stitch_seconds": MetricSpec(
+        "histogram", (),
+        "Per-request host-side stitch time of the tiled forward: "
+        "feature-map assembly copies plus the head forward on the "
+        "stitched features.",
+    ),
+    "tiled_tile_stream_seconds": MetricSpec(
+        "histogram", (),
+        "Per-request tile-streaming time of the tiled forward: window "
+        "slicing, double-buffered H2D staging, and the section "
+        "executable's device compute (everything but the stitch).",
+    ),
     # -- memory observability (mpi4dl_tpu/telemetry/memory.py) ---------------
     "device_hbm_used_bytes": MetricSpec(
         "gauge", ("device",),

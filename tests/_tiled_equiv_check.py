@@ -1,0 +1,78 @@
+"""Subprocess half of the tiled stitch-exactness suite: run on a
+SINGLE-device CPU backend — the tiled predictor's actual deployment
+topology (one chip serving huge images) — and compare the tile-streaming
+forward against the monolithic forward BIT FOR BIT across tile grids and
+model families. Prints one JSON verdict line.
+
+Why a subprocess: the test harness simulates an 8-device mesh
+(``conftest.set_cpu_devices(8)``), under which XLA:CPU partitions each
+program's intra-op work differently per SHAPE — two programs computing
+the same window bytes (a 40×40 section window vs the 56×56 monolithic
+forward) can then round differently in the last bit, the repo's standard
+cross-executable f32 boundary. On one device the per-shape partitioning
+coincides and the stitched forward is bit-identical, which is the claim
+that matters for the single-chip gigapixel deployment.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import aot_compile_predict, collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v1, get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve.tiled import TiledPredictor
+
+    assert len(jax.devices()) == 1, "this check needs ONE device"
+    results = {}
+
+    def check(tag, cells, size, tile, seed):
+        rng = np.random.default_rng(seed)
+        params = init_cells(
+            cells, jax.random.PRNGKey(seed), jnp.zeros((1, size, size, 3))
+        )
+        stats = collect_batch_stats(
+            cells, params,
+            [jnp.asarray(
+                rng.standard_normal((2, size, size, 3)), jnp.float32
+            )],
+        )
+        mono = aot_compile_predict(
+            cells, params, stats, (size, size, 3), [1]
+        )[1]
+        for t in tile if isinstance(tile, list) else [tile]:
+            pred = TiledPredictor(
+                cells, params, stats, (size, size, 3), t
+            )
+            handle = pred.compile_bucket(1)
+            x = rng.standard_normal((1, size, size, 3)).astype(np.float32)
+            got = pred.run(handle, x)
+            want = np.asarray(mono(params, stats, x))
+            results[f"{tag}_t{t}"] = bool(np.array_equal(got, want))
+
+    # v1 at a ragged size: square/rect cores, ragged last tiles, the
+    # single-window degenerate; v2 (pre-activation bottlenecks, 1x1
+    # stride-2 shortcuts) at a tiny tile (8x8 grid).
+    check(
+        "v1_56",
+        get_resnet_v1(depth=8, num_classes=10, pool_kernel=14),
+        56, [16, (16, 24), 48], seed=0,
+    )
+    check(
+        "v2_32",
+        get_resnet_v2(depth=11, num_classes=10, pool_kernel=8),
+        32, [4], seed=1,
+    )
+    ok = all(results.values())
+    print(json.dumps({"ok": ok, "bit_identical": results}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,61 @@ def test_fleet_recovery_series_trended_and_inverted(tmp_path):
     assert by_key["fleet_2replica.recovery_s"]["verdict"] == "regressed"
 
 
+def test_tiled_gigapixel_series_trended_with_correct_signs(tmp_path):
+    """ISSUE satellite: the tiled_gigapixel extra trends its capability
+    point (peak_px — the largest image one chip served through the tile
+    stream) with the NORMAL sign and its fixed-size per-request p99 with
+    the INVERTED sign: a shrunk peak or a slower gigapixel request is
+    the regression."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def tiled(peak_px, p99):
+        r = _result(7.0, 0.5)
+        r["extras"]["tiled_gigapixel"] = {
+            "peak_px": peak_px, "image_px": 8192, "tile": 2048,
+            "latency_ms": {"p50": p99 / 2, "p99": p99},
+        }
+        return r
+
+    s = extract_series(tiled(16384, 61000.0))
+    assert s["tiled_gigapixel.peak_px"] == 16384.0
+    assert s["tiled_gigapixel.latency_p99_ms"] == 61000.0
+    assert not lower_is_better("tiled_gigapixel.peak_px")
+    assert lower_is_better("tiled_gigapixel.latency_p99_ms")
+    # The serving extra's own latency_ms stays UNtrended (its tail is
+    # trended as the p99/p50 ratio; absolute latency is box noise) —
+    # the extraction is gated on the tiled extra's peak_px shape.
+    r = _result(7.0, 0.5)
+    r["extras"]["serving_amoebanet3_32px"] = {
+        "value": 2000.0, "latency_ms": {"p50": 10.0, "p99": 30.0},
+    }
+    assert "serving_amoebanet3_32px.latency_p99_ms" not in extract_series(r)
+    # Shrunk capability regresses...
+    good, shrunk = tiled(16384, 61000.0), tiled(8192, 61000.0)
+    paths = _write_rounds(tmp_path, [_round(1, 0, good),
+                                     _round(2, 0, shrunk)])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [good, shrunk]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["tiled_gigapixel.peak_px"]["verdict"] == "regressed"
+    # ...and so does a slower fixed-size request at a held peak.
+    slow = tiled(16384, 75000.0)
+    paths = _write_rounds(tmp_path, [_round(1, 0, good),
+                                     _round(2, 0, slow)])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [good, slow]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["tiled_gigapixel.latency_p99_ms"]["verdict"] == "regressed"
+
+
 def test_fleet_recovery_by_domain_trended_and_inverted(tmp_path):
     """ISSUE CI satellite (HA front door): the fleet extra now records
     one recovery latency PER FAILURE DOMAIN ({"replica": ..., "router":

@@ -287,6 +287,41 @@ def test_analyze_memory_plan_cli(tmp_path, capsys):
     assert main(["memory-plan", "--baseline", str(empty)]) == 2
 
 
+def test_analyze_memory_plan_bisect_tile_cli(tmp_path, capsys):
+    """ISSUE satellite: ``analyze memory-plan --bisect tile`` — the
+    gigapixel pre-run question "what tile size fits this chip" answered
+    in pure compile mode (section-window + stitched-head executables
+    lowered abstractly, nothing executed), binary-searched over the
+    tile ladder, exit 1 when no tile fits."""
+    from mpi4dl_tpu.analysis.cli import main
+
+    plan_path = tmp_path / "tileplan.json"
+    rc = main([
+        "memory-plan", "--program", "serve", "--size", "64",
+        "--bisect", "tile", "--tile-candidates", "16",
+        "--tile-bucket", "1", "--limit-gb", "4",
+        "--json", str(plan_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "max feasible tile: 16" in out
+    plan = json.load(open(plan_path))
+    assert plan["bisect"]["axis"] == "tile"
+    assert plan["bisect"]["max_feasible"] == 16
+    # Every compiled candidate reports BOTH executables' peaks — the
+    # head is the image-bound residual the tile size cannot shrink.
+    cand = plan["bisect"]["candidates"][-1]
+    assert cand["tile_peak_bytes"] > 0 and cand["head_peak_bytes"] > 0
+    # No tile fits an absurd limit → CI-visible exit 1.
+    rc = main([
+        "memory-plan", "--program", "serve", "--size", "64",
+        "--bisect", "tile", "--tile-candidates", "16",
+        "--tile-bucket", "1", "--limit-bytes", "1000",
+    ])
+    assert rc == 1
+    capsys.readouterr()
+
+
 def test_analyze_sp_overlap_cli_decomposed_crosscheck(tmp_path, capsys):
     """ISSUE CI satellite: `python -m mpi4dl_tpu.analyze sp-overlap` on
     the DECOMPOSED arm — a live SP 2×2 capture of the decomposed-conv

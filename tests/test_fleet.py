@@ -192,7 +192,14 @@ def test_router_replay_dedupes_redispatches_and_expires(tmp_path):
         # true orphan was executed exactly once.
         deadline = time.monotonic() + 20
         while time.monotonic() < deadline:
-            if "t-orphan" in fake.served_trace_ids:
+            # Wait for the JOURNAL-visible completion too: the replica
+            # records the serve before the router's dispatcher thread
+            # journals done, so the replica-side signal alone races the
+            # final scan below.
+            if (
+                "t-orphan" in fake.served_trace_ids
+                and not scan(path).orphans
+            ):
                 break
             time.sleep(0.05)
         assert fake.served_trace_ids.count("t-already-served") == 1

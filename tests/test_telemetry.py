@@ -587,6 +587,15 @@ def full_stack(tmp_path_factory):
     from mpi4dl_tpu import fleet
 
     fleet.declare_metrics(reg)
+    # Tiled publisher (mpi4dl_tpu/serve/tiled.py): same pattern — the
+    # tiled_* names declared in one call; the live series (a real tiled
+    # engine streaming + stitching) are exercised by
+    # tests/test_serve_tiled.py, and running a second engine against
+    # THIS registry would perturb the counters the span/scrape tests
+    # below reconcile against the loadgen report.
+    from mpi4dl_tpu.serve import tiled as serve_tiled
+
+    serve_tiled.declare_metrics(reg)
     engine.stop()
     engine.lint_report()  # hlolint_* gauges
 
