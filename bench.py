@@ -625,7 +625,7 @@ def _measure_fleet() -> dict:
                     client, n_requests, concurrency=12, deadline_s=120.0,
                 ))
 
-            t = threading.Thread(target=load)
+            t = threading.Thread(target=load, name="fleet-drill-load")
             t.start()
             deadline = time.monotonic() + 120
             while time.monotonic() < deadline:
@@ -990,7 +990,33 @@ def _hlo_overlap_metrics() -> "dict | None":
         # The static report is the "should overlap" side the measured
         # trace attribution cross-checks against (_trace_attribution).
         _LAST_RUN["lint_report"] = rep
+        # Static cost model (docs/ANALYSIS.md "Reading the cost model"):
+        # price the same collective inventory under the live CPU prior and
+        # the ICI prior, so BENCH_*.json carries the predicted comms time
+        # and overlap ceiling next to the measured numbers and
+        # `analyze bench-history` can trend predicted-vs-measured drift.
+        from mpi4dl_tpu.analysis.costmodel import (
+            predict_from_report,
+            publish_prediction,
+        )
+
+        costmodel = {}
+        for ic in ("cpu", "ici"):
+            pred = predict_from_report(rep, interconnect=ic)
+            costmodel[ic] = {
+                "comms_s": pred["comms_s"],
+                "exposed_s": pred["exposed_s"],
+                "predicted_overlap_ratio": pred["overlap_ratio"],
+                "overlap_claim": pred["overlap_claim"],
+            }
+            if _REGISTRY is not None:
+                publish_prediction(pred, _REGISTRY, program="train_step")
+            if ic == "cpu":
+                # The prior matching the runtime we actually measure on;
+                # _trace_attribution cross-checks drift against this one.
+                _LAST_RUN["costmodel_pred"] = pred
         return {
+            "costmodel": costmodel,
             "inventory": {k: v for k, v in rep.inventory.items() if v},
             "total_collective_bytes": rep.overlap["total_bytes"],
             "bytes_by_op": rep.overlap["bytes_by_op"],
@@ -1050,6 +1076,31 @@ def _trace_attribution() -> "dict | None":
             out["crosscheck"] = [
                 f.as_dict() for f in crosscheck_overlap(lint_rep, summary)
             ]
+        pred = _LAST_RUN.get("costmodel_pred")
+        if pred is not None:
+            from mpi4dl_tpu.analysis.costmodel import crosscheck_cost_model
+
+            measured = summary["collective"].get("overlap_ratio")
+            out["costmodel"] = {
+                "interconnect": pred["interconnect"],
+                "predicted_overlap_ratio": pred["overlap_ratio"],
+                "overlap_claim": pred["overlap_claim"],
+                # Drift is only meaningful when the model makes an overlap
+                # claim (async collectives present); the CPU mesh compiles
+                # sync-only programs, so bench lines record null there and
+                # the series starts populating on the first ICI run.
+                "overlap_drift": (
+                    abs(float(measured) - float(pred["overlap_ratio"]))
+                    if pred["overlap_claim"] and measured is not None
+                    else None
+                ),
+                "crosscheck": [
+                    f.as_dict()
+                    for f in crosscheck_cost_model(
+                        pred, measured_overlap=measured
+                    )
+                ],
+            }
         return out
     except Exception as e:  # noqa: BLE001 — advisory metrics only
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}

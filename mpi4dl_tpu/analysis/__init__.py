@@ -28,6 +28,25 @@ a stray resharding ``all-to-all``, lost overlap, or a peak-HBM regression
 fails in CI before ever paying a TPU run. See ``docs/ANALYSIS.md``.
 """
 
+from mpi4dl_tpu.analysis.costmodel import (  # noqa: F401
+    INTERCONNECTS,
+    Interconnect,
+    collective_seconds,
+    crosscheck_cost_model,
+    predict_from_report,
+    predict_program,
+    publish_prediction,
+)
+from mpi4dl_tpu.analysis.expectations import (  # noqa: F401
+    CollectiveDelta,
+    compose,
+    data_parallel_delta,
+    pipeline_delta,
+    single_chip_delta,
+    spatial_delta,
+    spatial_join_delta,
+    tiled_delta,
+)
 from mpi4dl_tpu.analysis.hlo import (  # noqa: F401
     HloComputation,
     HloInstruction,

@@ -83,6 +83,23 @@ def extract_series(result: dict) -> "dict[str, float]":
         hlo.get("peak_hbm_bytes"), (int, float)
     ):
         out["hlo.peak_hbm_bytes"] = float(hlo["peak_hbm_bytes"])
+    # Static cost model (analysis/costmodel.py): the predicted overlap
+    # ceiling per interconnect prior trends with the normal sign (a
+    # falling ceiling means the compiled schedule lost hideability), and
+    # predicted comms seconds with the INVERTED one (the program started
+    # moving more bytes or lost async pairs).
+    if isinstance(hlo, dict) and isinstance(hlo.get("costmodel"), dict):
+        for ic, pred in hlo["costmodel"].items():
+            if not isinstance(pred, dict):
+                continue
+            ratio = pred.get("predicted_overlap_ratio")
+            if isinstance(ratio, (int, float)):
+                out[f"costmodel.predicted_overlap_ratio[{ic}]"] = float(
+                    ratio
+                )
+            comms = pred.get("comms_s")
+            if isinstance(comms, (int, float)):
+                out[f"costmodel.predicted_comms_s[{ic}]"] = float(comms)
     # Headline measured overlap: the fraction of collective time hidden
     # behind compute in the train-step capture. Falling = regression
     # (the inverse sign of the latency/memory series below).
@@ -91,6 +108,13 @@ def extract_series(result: dict) -> "dict[str, float]":
         ratio = (attr.get("overlap") or {}).get("overlap_ratio")
         if isinstance(ratio, (int, float)):
             out["attribution.trace_overlap_ratio"] = float(ratio)
+        # Predicted-vs-measured overlap drift (only recorded when the
+        # model makes an overlap claim — null on the sync-only CPU mesh,
+        # populated from the first ICI round on). INVERTED sign: growing
+        # drift means the cost model is diverging from reality and fails.
+        drift = (attr.get("costmodel") or {}).get("overlap_drift")
+        if isinstance(drift, (int, float)):
+            out["costmodel.overlap_drift"] = float(drift)
     for name, entry in (result.get("extras") or {}).items():
         if not isinstance(entry, dict):
             continue
@@ -192,10 +216,12 @@ def extract_series(result: dict) -> "dict[str, float]":
 def lower_is_better(key: str) -> bool:
     """Memory, latency, step-time, tail-shape, and bubble series regress
     UPWARD: a grown footprint, a slower death-to-replacement, a slower SP
-    train step, a fatter p99/p50 tail, or a grown pipeline bubble is the
-    failure, a shrunk one the improvement — the inverse of every
-    throughput/capability/overlap-ratio series (``trace_overlap_ratio``
-    keeps the normal direction: FALLING overlap fails CI)."""
+    train step, a fatter p99/p50 tail, a grown pipeline bubble, grown
+    predicted comms time, or growing predicted-vs-measured cost-model
+    drift is the failure, a shrunk one the improvement — the inverse of
+    every throughput/capability/overlap-ratio series
+    (``trace_overlap_ratio`` and ``predicted_overlap_ratio`` keep the
+    normal direction: FALLING overlap fails CI)."""
     return (
         "peak_hbm_bytes" in key
         or ".recovery_s" in key
@@ -204,6 +230,8 @@ def lower_is_better(key: str) -> bool:
         or ".sched_tight_p99_ms" in key
         or ".latency_p99_ms" in key
         or ".bubble_fraction[" in key
+        or ".predicted_comms_s[" in key
+        or key.endswith(".overlap_drift")
     )
 
 

@@ -45,7 +45,14 @@ the bit-identity crosscheck between arms
 schedule A/B — gpipe vs interleaved 1f1b — with live per-stage trace
 attribution, the measured bubble fraction cross-checked against the
 schedule model, and the exact stage-permute lint budget
-(:mod:`mpi4dl_tpu.analysis.pipeline_bench`).
+(:mod:`mpi4dl_tpu.analysis.pipeline_bench`);
+``python -m mpi4dl_tpu.analyze costmodel`` prices a compiled program's
+collectives under a parameterized interconnect table — predicted comms
+seconds, achievable overlap ceiling, schedule-model bubble — publishes
+the ``hlolint_predicted_*`` gauges, and crosschecks against a live trace
+capture (``cost-model-crosscheck``); its ``--artifact`` mode prices
+committed lint-report JSONs with no jax at all
+(:mod:`mpi4dl_tpu.analysis.costmodel`).
 """
 
 from __future__ import annotations
@@ -207,6 +214,15 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.serving_overlap import main as serving_ab
 
         return serving_ab(argv[1:])
+    if argv and argv[0] == "costmodel":
+        # Static communication cost model. Its --artifact mode (price
+        # committed lint-report JSONs under an interconnect table) is
+        # pure JSON and dispatches before any backend setup, like
+        # bench-history; the live mode compiles on its own mesh and
+        # crosschecks the predictions against a short trace capture.
+        from mpi4dl_tpu.analysis.costmodel import main as costmodel_main
+
+        return costmodel_main(argv[1:])
     if argv and argv[0] == "memory-plan":
         # Feasibility planner. Its artifact mode (committed peaks vs a
         # limit) is pure JSON and must dispatch before any backend
@@ -234,9 +250,9 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from mpi4dl_tpu.analysis.expectations import compose
     from mpi4dl_tpu.analysis.memory import load_baseline, write_baseline
     from mpi4dl_tpu.analysis.report import analyze_compiled
-    from mpi4dl_tpu.analysis.rules import Expectations
 
     platform = jax.devices()[0].platform
     trainer, cfg, n_sp = _build_trainer(args)
@@ -249,13 +265,10 @@ def main(argv=None) -> int:
     xs, ys = trainer.shard_batch(x, y)
     compiled = trainer._jit_step.lower(state, xs, ys).compile()
 
-    if n_sp > 0:
-        halo_shifts = trainer.halo_shift_count(state.params, x_shape)
-        expected = Expectations(
-            tile_shape=cfg.tile_shape, halo_shifts=halo_shifts
-        )
-    else:
-        expected = Expectations(pure_dp=True)
+    # Algebra-derived gate: the trainer contributes its layer deltas
+    # (spatial halo window or pure-DP) and compose() folds them into the
+    # Expectations the rules consume — no hand-built special cases.
+    expected = compose(trainer.collective_deltas(state.params, x_shape))
 
     key = _config_key(args, platform)
     baseline = load_baseline(key, args.baseline)

@@ -57,7 +57,8 @@ def _build_arm(impl, size, batch, depth, spatial_cells, warmup):
     import jax.numpy as jnp
     import numpy as np
 
-    from mpi4dl_tpu.analysis import Expectations, analyze_compiled
+    from mpi4dl_tpu.analysis import analyze_compiled
+    from mpi4dl_tpu.analysis.expectations import compose, spatial_delta
     from mpi4dl_tpu.config import ParallelConfig
     from mpi4dl_tpu.models.resnet import get_resnet_v1
     from mpi4dl_tpu.train import Trainer
@@ -85,9 +86,7 @@ def _build_arm(impl, size, batch, depth, spatial_cells, warmup):
         compiled = trainer._jit_step.lower(state, xs, ys).compile()
         report = analyze_compiled(
             compiled,
-            expected=Expectations(
-                tile_shape=cfg.tile_shape, halo_shifts=halo_shifts
-            ),
+            expected=compose(spatial_delta(cfg.tile_shape, halo_shifts)),
             platform=jax.devices()[0].platform,
             config={"program": f"sp2x2_train_{impl}", "conv_overlap": impl},
         )

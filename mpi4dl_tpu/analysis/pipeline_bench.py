@@ -42,7 +42,8 @@ def _build_arm(schedule, size, batch, depth, stages, parts, virtual_stages,
     import jax.numpy as jnp
     import numpy as np
 
-    from mpi4dl_tpu.analysis import Expectations, analyze_compiled
+    from mpi4dl_tpu.analysis import analyze_compiled
+    from mpi4dl_tpu.analysis.expectations import compose
     from mpi4dl_tpu.config import ParallelConfig
     from mpi4dl_tpu.models.resnet import get_resnet_v1
     from mpi4dl_tpu.parallel.pipeline import PipelineTrainer
@@ -67,12 +68,13 @@ def _build_arm(schedule, size, batch, depth, stages, parts, virtual_stages,
     hlo_text = compiled.as_text()
     report = analyze_compiled(
         compiled,
-        # Pure-LP program: zero halo shifts, so the permute window
-        # collapses to exactly the stage-boundary budget — the compiled
-        # inventory must sit AT stage_permute_count() or the lint errors.
-        expected=Expectations(
-            halo_shifts=0, extra_permutes=trainer.stage_permute_count()
-        ),
+        # Pure-LP program: the trainer's composed deltas carry zero halo
+        # shifts, so the permute window collapses to exactly the
+        # stage-boundary budget — the compiled inventory must sit AT
+        # stage_permute_count() or the lint errors.
+        expected=compose(trainer.collective_deltas(
+            state, (batch, size, size, 3)
+        )),
         platform=jax.devices()[0].platform,
         config={
             "program": f"pipeline_{schedule}", "schedule": schedule,

@@ -63,6 +63,11 @@ class Expectations:
     # resharding/replicating something that regressed off the single
     # device, turning every request into cross-chip traffic.
     single_chip: bool = False
+    # EXACT all-gather entitlement (the SP→LP tile join into the
+    # replicated head: fwd gather + backward re-gather on a train step).
+    # None disables the rule — only composed stacks that CLAIM the join
+    # (analysis.expectations.spatial_join_delta) are gated on it.
+    join_gathers: int | None = None
 
 
 @dataclasses.dataclass
@@ -167,6 +172,30 @@ def _rule_halo_permute_count(ctx: LintContext) -> list[Finding]:
     return [Finding("halo-permute-count", "error", msg)]
 
 
+def _rule_join_gather_count(ctx: LintContext) -> list[Finding]:
+    exp = ctx.expected
+    if exp.join_gathers is None:
+        return []
+    actual = ctx.inventory.get("all-gather", 0)
+    if actual == exp.join_gathers:
+        return []
+    if actual < exp.join_gathers:
+        msg = (
+            f"{actual} all-gather op(s) but the composed stack claims "
+            f"exactly {exp.join_gathers} SP→LP join gathers: the tile join "
+            "was elided or moved off the gather path (head no longer "
+            "replicated? join fused into a reshard?)."
+        )
+    else:
+        msg = (
+            f"{actual} all-gather op(s) exceed the composed join budget of "
+            f"{exp.join_gathers}: gathers beyond the tile join mean an "
+            "activation or gradient is being re-replicated mid-program "
+            "(sharding regressed between layers)."
+        )
+    return [Finding("join-gather-count", "error", msg)]
+
+
 def _rule_zero_overlap(ctx: LintContext) -> list[Finding]:
     out = []
     for r in ctx.records:
@@ -264,6 +293,9 @@ DEFAULT_RULES: tuple[Rule, ...] = (
     Rule("halo-permute-count",
          "collective-permute count must sit in the partition-math window",
          _rule_halo_permute_count),
+    Rule("join-gather-count",
+         "all-gather count must equal the composed SP→LP join claim",
+         _rule_join_gather_count),
     Rule("zero-overlap-collective",
          "async collectives must overlap compute", _rule_zero_overlap),
     Rule("peak-memory-regression",

@@ -698,6 +698,68 @@ class PipelineTrainer:
         ``Expectations.extra_permutes`` pins the permute window with."""
         return 2 * (self.n_virtual - 1)
 
+    def halo_shift_count(self, state, x_shape, dtype=jnp.float32) -> int:
+        """Forward halo shift ppermutes of the SPATIAL FRONT in one
+        un-scanned pass — the same partition-math floor as
+        :meth:`mpi4dl_tpu.train.Trainer.halo_shift_count`, counted by
+        abstract tracing of ``_front`` alone (no back-phase scan, no
+        backward: the stage wires ride the EXACT budget from
+        :meth:`stage_permute_count`, not this window). ``x_shape`` is the
+        unsharded global batch shape ``[B, H, W, C]``. 0 when the model
+        has no spatial cells."""
+        from mpi4dl_tpu.parallel.halo import count_halo_shifts
+
+        if not self.front_cells:
+            return 0
+
+        def local(front_flat, x):
+            out = self._front(front_flat, x)
+            return jax.tree.map(lambda a: jnp.sum(a, dtype=jnp.float32), out)
+
+        fn = shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(), self.x_spec),
+            out_specs=P(),
+            check_vma=False,
+        )
+        b = int(x_shape[0])
+        xs = jax.ShapeDtypeStruct(
+            (self.parts, b // self.parts) + tuple(x_shape[1:]), dtype
+        )
+        with count_halo_shifts() as box:
+            jax.eval_shape(fn, state.params[0], xs)
+        return box[0]
+
+    def collective_deltas(self, state, x_shape, dtype=jnp.float32):
+        """This trainer's layer deltas for the expectations algebra
+        (:mod:`mpi4dl_tpu.analysis.expectations`): the spatial front's
+        halo window + the SP→LP join gather pair (when spatial cells
+        exist) stacked with the back phase's exact stage-permute budget.
+        Gate a compiled step with
+        ``compose(*trainer.collective_deltas(state, x_shape))``."""
+        from mpi4dl_tpu.analysis.expectations import (
+            pipeline_delta,
+            spatial_delta,
+            spatial_join_delta,
+        )
+
+        deltas = []
+        if self.front_cells:
+            deltas.append(spatial_delta(
+                self.config.tile_shape,
+                self.halo_shift_count(state, x_shape, dtype=dtype),
+            ))
+            if not (self.S > 1 and self.parts % self.S == 0):
+                # Tile join into the replicated head: fwd gather + its
+                # backward re-gather. When the front instead shards
+                # micro-batches over the pipe axis, its pipe all_gather
+                # (and the AD transpose) joins the gather class with a
+                # fusion-dependent count — no exact claim then.
+                deltas.append(spatial_join_delta(2))
+        deltas.append(pipeline_delta(self.stage_permute_count()))
+        return tuple(deltas)
+
     def capture_trace_attribution(
         self,
         state,

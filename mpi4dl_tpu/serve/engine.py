@@ -267,11 +267,19 @@ class SingleChipPredictor:
         return compiled(self.params, self.stats, staged)
 
     def expectations(self):
-        """Mesh-derived hlolint expectations: one chip → ANY collective
-        in the compiled forward is a resharding regression."""
-        from mpi4dl_tpu.analysis.rules import Expectations
+        """Algebra-derived hlolint expectations: the single-chip
+        zero-collective delta composes to a gate where ANY collective in
+        the compiled forward is a resharding regression."""
+        from mpi4dl_tpu.analysis.expectations import compose
 
-        return Expectations(single_chip=True)
+        return compose(self.collective_deltas())
+
+    def collective_deltas(self):
+        """One chip → one zero-collective layer delta
+        (:mod:`mpi4dl_tpu.analysis.expectations`)."""
+        from mpi4dl_tpu.analysis.expectations import single_chip_delta
+
+        return (single_chip_delta(),)
 
     def platform(self) -> str:
         return self.device.platform

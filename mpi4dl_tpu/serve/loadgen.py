@@ -395,7 +395,10 @@ def run_closed_loop(
                 slo_class=cls,
             )
 
-    threads = [threading.Thread(target=client) for _ in range(concurrency)]
+    threads = [
+        threading.Thread(target=client, name=f"loadgen-closed-{i}")
+        for i in range(concurrency)
+    ]
     t0 = time.perf_counter()
     for th in threads:
         th.start()
@@ -468,6 +471,7 @@ def run_open_loop(
             w = threading.Thread(
                 target=submit_and_resolve,
                 args=(make_example(n), tid, t, cls, cls_deadline),
+                name=f"loadgen-open-retry-{n}",
             )
             w.start()
             waiters.append(w)
@@ -488,6 +492,7 @@ def run_open_loop(
             target=tally.resolve, args=(fut, t),
             kwargs={"trace_id": tid, "t_submitted": time.monotonic(),
                     "slo_class": cls},
+            name=f"loadgen-open-waiter-{n}",
         )
         w.start()
         waiters.append(w)

@@ -219,14 +219,20 @@ class ShardedPredictor:
         return compiled(self.params, self.stats, staged)
 
     def expectations(self):
-        """Mesh-derived hlolint expectations: the partition-math
-        halo-permute window off the counted forward shifts — the gate
-        flip from the single-chip zero-collectives rule."""
-        from mpi4dl_tpu.analysis.rules import Expectations
+        """Algebra-derived hlolint expectations: the spatial layer delta
+        (partition-math halo window off the counted forward shifts)
+        composes to the permute-window gate — the flip from the
+        single-chip zero-collectives rule."""
+        from mpi4dl_tpu.analysis.expectations import compose
 
-        return Expectations(
-            tile_shape=self.mesh_shape, halo_shifts=self.halo_shifts()
-        )
+        return compose(self.collective_deltas())
+
+    def collective_deltas(self):
+        """One spatial layer delta over this predictor's tile mesh
+        (:mod:`mpi4dl_tpu.analysis.expectations`)."""
+        from mpi4dl_tpu.analysis.expectations import spatial_delta
+
+        return (spatial_delta(self.mesh_shape, self.halo_shifts()),)
 
     def platform(self) -> str:
         return self.limit_device().platform

@@ -489,11 +489,19 @@ class TiledPredictor:
         return np.stack(outs)
 
     def expectations(self):
-        """The tile executable is a one-chip program: any collective in
-        it is a resharding regression (the single-chip gate)."""
-        from mpi4dl_tpu.analysis.rules import Expectations
+        """Algebra-derived: the tiled zero-collective delta composes to
+        the single-chip gate — any collective in a tile executable is a
+        resharding regression."""
+        from mpi4dl_tpu.analysis.expectations import compose
 
-        return Expectations(single_chip=True)
+        return compose(self.collective_deltas())
+
+    def collective_deltas(self):
+        """One tiled zero-collective section delta
+        (:mod:`mpi4dl_tpu.analysis.expectations`)."""
+        from mpi4dl_tpu.analysis.expectations import tiled_delta
+
+        return (tiled_delta(),)
 
     def platform(self) -> str:
         return self.device.platform

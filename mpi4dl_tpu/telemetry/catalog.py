@@ -446,6 +446,27 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Peak buffer-assignment bytes (argument + output + temp - alias) "
         "of the compiled program; 0 when the backend cannot report it.",
     ),
+    "hlolint_predicted_comms_seconds": MetricSpec(
+        "gauge", ("program", "interconnect"),
+        "Static cost-model prediction: total collective seconds under "
+        "the named interconnect table "
+        "(mpi4dl_tpu/analysis/costmodel.py ring/neighbor formulas).",
+    ),
+    "hlolint_predicted_overlap_ratio": MetricSpec(
+        "gauge", ("program", "interconnect"),
+        "Static cost-model prediction: achievable overlap CEILING — the "
+        "fraction of predicted collective seconds whose start->done "
+        "window has compute scheduled inside it (0 with no claim when "
+        "the program's collectives are all synchronous, e.g. every "
+        "CPU-mesh program).",
+    ),
+    "hlolint_predicted_bubble_fraction": MetricSpec(
+        "gauge", ("program", "interconnect"),
+        "Static cost-model prediction: schedule-model pipeline bubble "
+        "(PipelineTrainer.analytic_bubble_fraction) — only published "
+        "for pipeline programs; crosschecked against the measured "
+        "pipeline_bubble_fraction by cost-model-crosscheck.",
+    ),
 }
 
 

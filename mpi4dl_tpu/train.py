@@ -1008,6 +1008,26 @@ class Trainer:
             jax.eval_shape(fn, params, x)
         return box[0]
 
+    def collective_deltas(self, params, x_shape, dtype=jnp.float32):
+        """This trainer's layer deltas for the expectations algebra
+        (:mod:`mpi4dl_tpu.analysis.expectations`): the spatial front's
+        halo entitlement over the counted forward shifts when cells are
+        spatially partitioned, else the pure-DP entitlement. Gate a
+        compiled step with ``compose(*trainer.collective_deltas(...))``."""
+        from mpi4dl_tpu.analysis.expectations import (
+            data_parallel_delta,
+            spatial_delta,
+        )
+
+        if self.n_spatial > 0:
+            return (
+                spatial_delta(
+                    self.config.tile_shape,
+                    self.halo_shift_count(params, x_shape, dtype=dtype),
+                ),
+            )
+        return (data_parallel_delta(),)
+
     def publish_telemetry(
         self, registry=None, params=None, x_shape=None, dtype=jnp.float32
     ):

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""selflint — stdlib-ast hygiene lint over this repo's own source.
+
+hlolint (mpi4dl_tpu/analysis) lints the *compiled HLO*; this script lints
+the *Python that produces and measures it*, catching the three recurring
+hygiene bugs that corrupt measurements or hang CI without ever failing a
+functional test:
+
+- ``wallclock-compare``: ``time.time()`` used inside a comparison.
+  Wall-clock time jumps under NTP slew; deadline/elapsed comparisons must
+  use ``time.monotonic()`` or ``time.perf_counter()``. Timestamps (stored,
+  printed, subtracted for display) are fine — only a ``time.time()`` call
+  nested inside an ``ast.Compare`` is flagged.
+- ``uncataloged-metric``: a direct ``.gauge(`` / ``.counter(`` /
+  ``.histogram(`` call. Every metric series must be created through
+  ``telemetry.declare(registry, name)`` so the catalog check (name, type,
+  labels, docs table) covers it; direct registry calls bypass the catalog
+  and rot docs/OBSERVABILITY.md. The telemetry package's own internals
+  (the delegators that implement ``declare``) are allowlisted.
+- ``unnamed-thread``: ``threading.Thread(...)`` with neither ``name=``
+  nor ``daemon=``. An anonymous non-daemon thread is invisible in hang
+  dumps and can block interpreter exit — every thread must at least be
+  identifiable, and background loops must be daemons.
+
+Scan scope: ``mpi4dl_tpu/``, ``scripts/``, ``bench.py`` (tests are
+excluded — they monkeypatch clocks and registries on purpose). Pure
+stdlib, no jax import: safe for pre-commit and CI front doors.
+
+Usage::
+
+    python scripts/selflint.py [--root DIR] [--json]
+
+Exit 0 when clean, 1 on any finding, 2 on usage/parse errors.
+Tier-1 coverage: ``tests/test_selflint.py`` pins each rule on synthetic
+snippets and asserts the real repo scans clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+# Paths (relative, "/"-separated) where a rule is intentionally violated.
+# Keep this list SHORT and justified: every entry is a hole in the lint.
+ALLOWLIST: "dict[str, set[str]]" = {
+    # The telemetry internals that IMPLEMENT declare() must call the
+    # underlying registry constructors directly.
+    "uncataloged-metric": {
+        "mpi4dl_tpu/telemetry/catalog.py",
+        "mpi4dl_tpu/telemetry/federation.py",
+    },
+    "wallclock-compare": set(),
+    "unnamed-thread": set(),
+}
+
+SCAN_ROOTS = ("mpi4dl_tpu", "scripts")
+SCAN_FILES = ("bench.py",)
+METRIC_METHODS = ("gauge", "counter", "histogram")
+
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    """``time.time()`` (or a bare ``time()`` imported from time)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "time":
+        return isinstance(f.value, ast.Name) and f.value.id == "time"
+    return False
+
+
+def _check_tree(tree: ast.AST, rel: str) -> "list[dict]":
+    out: "list[dict]" = []
+
+    def finding(rule: str, node: ast.AST, msg: str):
+        if rel in ALLOWLIST.get(rule, ()):
+            return
+        out.append({
+            "rule": rule, "path": rel, "line": node.lineno,
+            "message": msg,
+        })
+
+    for node in ast.walk(tree):
+        # wallclock-compare: time.time() anywhere under a Compare.
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if _is_wallclock_call(sub):
+                    finding(
+                        "wallclock-compare", sub,
+                        "time.time() inside a comparison — wall clock "
+                        "jumps under NTP; use time.monotonic() or "
+                        "time.perf_counter() for deadlines/elapsed",
+                    )
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # uncataloged-metric: direct obj.gauge(/counter(/histogram( call.
+        if isinstance(f, ast.Attribute) and f.attr in METRIC_METHODS:
+            finding(
+                "uncataloged-metric", node,
+                f".{f.attr}(...) bypasses the metric catalog — create "
+                "series via telemetry.declare(registry, name) so the "
+                "catalog/docs checks cover it",
+            )
+        # unnamed-thread: threading.Thread(...) without name= or daemon=.
+        is_thread = (
+            isinstance(f, ast.Attribute) and f.attr == "Thread"
+            and isinstance(f.value, ast.Name) and f.value.id == "threading"
+        ) or (isinstance(f, ast.Name) and f.id == "Thread")
+        if is_thread:
+            kwargs = {kw.arg for kw in node.keywords}
+            if not kwargs & {"name", "daemon"}:
+                finding(
+                    "unnamed-thread", node,
+                    "threading.Thread without name= or daemon= — "
+                    "anonymous threads are invisible in hang dumps and "
+                    "non-daemons can block interpreter exit",
+                )
+    return out
+
+
+def lint_file(path: str, rel: "str | None" = None) -> "list[dict]":
+    rel = (rel or path).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=path)
+    return _check_tree(tree, rel)
+
+
+def iter_sources(root: str):
+    """Yield (abspath, relpath) for every in-scope .py file. Tests are
+    excluded by construction: tests/ is not a scan root."""
+    for fname in SCAN_FILES:
+        p = os.path.join(root, fname)
+        if os.path.isfile(p):
+            yield p, fname
+    for top in SCAN_ROOTS:
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fname)
+                yield p, os.path.relpath(p, root).replace(os.sep, "/")
+
+
+def lint_repo(root: str) -> "list[dict]":
+    findings: "list[dict]" = []
+    for path, rel in iter_sources(root):
+        findings.extend(lint_file(path, rel))
+    return findings
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/selflint.py",
+        description="stdlib-ast hygiene lint over the repo's own source",
+    )
+    p.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root to scan (default: this script's repo)",
+    )
+    p.add_argument("--json", dest="json_out", action="store_true",
+                   help="emit the findings as a JSON array on stdout")
+    args = p.parse_args(argv)
+    try:
+        findings = lint_repo(args.root)
+    except (OSError, SyntaxError) as e:
+        print(f"selflint: {e}", file=sys.stderr)
+        return 2
+    if args.json_out:
+        print(json.dumps(findings, indent=2))
+    else:
+        for f in findings:
+            print(f"{f['path']}:{f['line']}: {f['rule']}: {f['message']}")
+        print(
+            f"selflint: {len(findings)} finding(s) over "
+            f"{sum(1 for _ in iter_sources(args.root))} file(s)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""ISSUE 16 satellite: pure-JSON dispatch of every artifact-mode analyze
+subcommand, pinned with a POISONED jax.
+
+The contract (docs/ANALYSIS.md): ``bench-history``, ``tail``,
+``trace-export``, ``memory-plan --ledger``, and ``costmodel --artifact``
+run on logs from a dead machine — no devices, no backend init, no jax
+*use*. The pin: each subcommand runs as a subprocess with a fake ``jax``
+package shadowing the real one on PYTHONPATH that raises on ANY
+attribute access or class instantiation (module import itself is
+tolerated — the package ``__init__`` imports jax at module level, and
+Python resolves that before the CLI ever dispatches). If a future edit
+makes an artifact path call ``jax.devices()``, build a Mesh, or touch
+``jnp`` at import time, these tests fail with the poison message."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_POISON_INIT = '''\
+"""Poisoned jax stand-in: importable, unusable."""
+_MSG = "poisoned jax touched: artifact-mode path must stay pure JSON"
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    raise RuntimeError(f"{_MSG} (jax.{name})")
+'''
+
+_POISON_SHARDING = '''\
+_MSG = "poisoned jax touched: artifact-mode path must stay pure JSON"
+
+
+class _PoisonType:
+    def __init__(self, *a, **k):
+        raise RuntimeError(_MSG + f" ({type(self).__name__}())")
+
+
+class Mesh(_PoisonType):
+    pass
+
+
+class NamedSharding(_PoisonType):
+    pass
+
+
+class PartitionSpec(_PoisonType):
+    pass
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    raise RuntimeError(f"{_MSG} (jax.sharding.{name})")
+'''
+
+_POISON_NUMPY = '''\
+_MSG = "poisoned jax touched: artifact-mode path must stay pure JSON"
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    raise RuntimeError(f"{_MSG} (jax.numpy.{name})")
+'''
+
+
+@pytest.fixture(scope="module")
+def poison(tmp_path_factory):
+    """A fake jax package dir + the env that puts it FIRST on sys.path
+    of any subprocess (and drops JAX_PLATFORMS — backend selection must
+    never matter on these paths)."""
+    root = tmp_path_factory.mktemp("poisoned")
+    pkg = root / "jax"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(_POISON_INIT)
+    (pkg / "sharding.py").write_text(_POISON_SHARDING)
+    (pkg / "numpy.py").write_text(_POISON_NUMPY)
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = os.pathsep.join([str(root), REPO])
+    return env
+
+
+def _run(args, env):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analyze", *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120,
+    )
+
+
+def test_poison_actually_poisons(poison):
+    """Guard on the guard: the fake jax shadows the real one and raises
+    on use — otherwise every pin below would vacuously pass."""
+    r = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        capture_output=True, text=True, env=poison, cwd=REPO, timeout=60,
+    )
+    assert r.returncode != 0
+    assert "poisoned jax touched" in r.stderr
+
+
+def test_bench_history_dispatches_pure_json(poison, tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({
+        "n": 1, "rc": 0,
+        "parsed": {"metric": "m", "value": 5.0, "extras": {}},
+    }))
+    r = _run(["bench-history", str(p)], poison)
+    assert r.returncode == 0, r.stderr
+    assert "0 regression(s)" in r.stdout
+    assert "poisoned" not in r.stderr
+
+
+def _span_log(tmp_path):
+    """Handcrafted span-event JSONL (the telemetry wire shape) — built
+    without importing mpi4dl_tpu here, so this module itself stays
+    independent of the package's import-time jax pull."""
+    log = tmp_path / "telemetry.jsonl"
+    log.write_text(json.dumps({
+        "ts": 100.0, "kind": "span", "name": "serve.request",
+        "trace_id": "t-1",
+        "spans": [{"phase": "device_compute", "start_s": 1.0,
+                   "end_s": 1.5, "duration_s": 0.5}],
+        "attrs": {"pid": 7, "outcome": "served", "e2e_latency_s": 0.5},
+    }) + "\n")
+    return log
+
+
+def test_tail_dispatches_pure_json(poison, tmp_path):
+    log = _span_log(tmp_path)
+    r = _run(["tail", str(log), "--top", "1"], poison)
+    assert r.returncode == 0, r.stderr
+    assert "t-1" in r.stdout
+    assert "poisoned" not in r.stderr
+
+
+def test_trace_export_dispatches_pure_json(poison, tmp_path):
+    log = _span_log(tmp_path)
+    out = tmp_path / "chrome.json"
+    r = _run(
+        ["trace-export", str(log), "--trace-id", "t-1", "-o", str(out)],
+        poison,
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(out.read_text())
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+    assert "poisoned" not in r.stderr
+
+
+def test_memory_plan_ledger_dispatches_pure_json(poison, tmp_path):
+    ledger = tmp_path / "ledger.json"
+    ledger.write_text(json.dumps({"entries": [
+        {"program": "serve_predict", "bucket": 8, "peak_bytes": 2**30},
+    ]}))
+    r = _run(
+        ["memory-plan", "--ledger", str(ledger),
+         "--limit-bytes", str(2**31)],
+        poison,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "fits" in r.stdout
+    assert "poisoned" not in r.stderr
+
+
+def test_costmodel_artifact_dispatches_pure_json(poison, tmp_path):
+    """ISSUE 16 tentpole surface: ``costmodel --artifact`` prices a
+    committed lint-report JSON under the ICI table with jax poisoned —
+    the campaign's prediction artifacts regenerate on any machine."""
+    rep = tmp_path / "report.json"
+    rep.write_text(json.dumps({
+        "module_name": "m",
+        "config": {"program": "sp2x2_train", "n_devices": 8},
+        "collectives": [
+            {"opcode": "collective-permute", "bytes_moved": 1048576,
+             "is_async": False, "compute_between": 0},
+            {"opcode": "all-gather", "bytes_moved": 2097152,
+             "is_async": True, "compute_between": 3},
+        ],
+    }))
+    out = tmp_path / "pred.json"
+    r = _run(
+        ["costmodel", "--artifact", str(rep), "--interconnect", "ici",
+         "--json", str(out)],
+        poison,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "costmodel[sp2x2_train] ici" in r.stdout
+    payload = json.loads(out.read_text())
+    assert payload["interconnect"] == "ici"
+    (pred,) = payload["predictions"]
+    assert pred["program"] == "sp2x2_train"
+    assert pred["n_collectives"] == 2 and pred["n_async"] == 1
+    assert pred["comms_s"] > 0 and pred["overlap_claim"] is True
+    assert "poisoned" not in r.stderr

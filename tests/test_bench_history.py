@@ -648,3 +648,88 @@ def test_serving_sharded_series_trended_with_correct_signs(tmp_path):
         _round(2, 0, with_sharded(0.58, 30.0, 295.0)),  # p99 +25%
     ])
     assert main(paths) == 1
+
+
+def test_costmodel_series_trended_with_correct_signs(tmp_path):
+    """ISSUE 16 satellite: bench lines embed the static cost model's
+    predictions (hlo.costmodel per interconnect) and the predicted-vs-
+    measured overlap drift (attribution.costmodel). bench-history trends
+    the predicted overlap ceiling with the NORMAL sign (a falling ceiling
+    means the compiled schedule lost hideability), predicted comms
+    seconds with the INVERTED sign (more bytes / lost async pairs), and
+    drift with the INVERTED sign — growing model divergence fails CI."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def with_costmodel(ici_ratio, ici_comms, drift):
+        r = _result(7.0, 0.5)
+        r["hlo"] = {
+            "peak_hbm_bytes": 10e9,
+            "costmodel": {
+                "cpu": {"comms_s": 3.1e-4, "exposed_s": 3.1e-4,
+                        "predicted_overlap_ratio": 0.0,
+                        "overlap_claim": False},
+                "ici": {"comms_s": ici_comms, "exposed_s": 0.0,
+                        "predicted_overlap_ratio": ici_ratio,
+                        "overlap_claim": True},
+            },
+        }
+        r["attribution"] = {
+            "overlap": {"overlap_ratio": 0.61, "verdict": "overlapped"},
+            "costmodel": {
+                "interconnect": "cpu",
+                "predicted_overlap_ratio": ici_ratio,
+                "overlap_claim": drift is not None,
+                "overlap_drift": drift,
+                "crosscheck": [],
+            },
+        }
+        return r
+
+    s = extract_series(with_costmodel(0.85, 2.8e-5, 0.10))
+    assert s["costmodel.predicted_overlap_ratio[ici]"] == 0.85
+    assert s["costmodel.predicted_overlap_ratio[cpu]"] == 0.0
+    assert s["costmodel.predicted_comms_s[ici]"] == 2.8e-5
+    assert s["costmodel.overlap_drift"] == 0.10
+    assert not lower_is_better("costmodel.predicted_overlap_ratio[ici]")
+    assert lower_is_better("costmodel.predicted_comms_s[ici]")
+    assert lower_is_better("costmodel.overlap_drift")
+    # CPU-mesh rounds record null drift (no overlap claim): absent-not-
+    # zero, so the series starts with the first round that claims.
+    assert "costmodel.overlap_drift" not in extract_series(
+        with_costmodel(0.85, 2.8e-5, None)
+    )
+
+    # Growing drift is the CI-visible regression even at flat headline.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_costmodel(0.85, 2.8e-5, 0.05)),
+        _round(2, 0, with_costmodel(0.85, 2.8e-5, 0.12)),
+    ])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [
+             with_costmodel(0.85, 2.8e-5, 0.05),
+             with_costmodel(0.85, 2.8e-5, 0.12),
+         ]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["costmodel.overlap_drift"]["verdict"] == "regressed"
+    # A falling predicted ceiling regresses (normal sign); grown
+    # predicted comms time regresses (inverted sign).
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_costmodel(0.85, 2.8e-5, 0.05)),
+        _round(2, 0, with_costmodel(0.60, 2.8e-5, 0.05)),
+    ])
+    assert main(paths) == 1
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_costmodel(0.85, 2.8e-5, 0.05)),
+        _round(2, 0, with_costmodel(0.85, 6.0e-5, 0.05)),
+    ])
+    assert main(paths) == 1
+    # Shrinking drift is the improvement direction.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_costmodel(0.85, 2.8e-5, 0.12)),
+        _round(2, 0, with_costmodel(0.85, 2.8e-5, 0.05)),
+    ])
+    assert main(paths) == 0

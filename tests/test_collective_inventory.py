@@ -33,9 +33,9 @@ import numpy as np
 import pytest
 
 from mpi4dl_tpu.analysis import (
-    Expectations,
     analyze_compiled,
     collective_inventory,
+    compose,
 )
 from mpi4dl_tpu.config import ParallelConfig
 from mpi4dl_tpu.models.resnet import get_resnet_v1
@@ -83,7 +83,10 @@ def test_pure_dp_inventory():
         "all-to-all": 0,
         "reduce-scatter": 0,
     }, inv
-    _no_errors(analyze_compiled(compiled, expected=Expectations(pure_dp=True)))
+    _no_errors(analyze_compiled(
+        compiled,
+        expected=compose(tr.collective_deltas(state.params, (4, 32, 32, 3))),
+    ))
 
 
 def test_spatial_trainer_inventory():
@@ -114,13 +117,13 @@ def test_spatial_trainer_inventory():
     # Partition-math derivation (no hand pin): one un-scanned forward
     # traces 20 shift ppermutes (5 exchanges x 4 shifts on the 2x2 grid),
     # so the compiled count must land in [20, 40] — and the full rule set
-    # must be clean on the real program.
+    # must be clean on the real program. The gate is the COMPOSED spatial
+    # delta, not a hand-built Expectations.
     shifts = tr.halo_shift_count(state.params, (4, 32, 32, 3))
     assert shifts == 20, shifts
-    report = analyze_compiled(
-        compiled,
-        expected=Expectations(tile_shape=cfg.tile_shape, halo_shifts=shifts),
-    )
+    (delta,) = tr.collective_deltas(state.params, (4, 32, 32, 3))
+    assert delta.layer == "spatial" and delta.halo_shifts == shifts
+    report = analyze_compiled(compiled, expected=compose(delta))
     _no_errors(report)
     # The report carries per-collective bytes for every record.
     assert report.overlap["total_bytes"] > 0
@@ -146,9 +149,8 @@ def test_sp_plus_lp_pipeline_inventory():
     tr = PipelineTrainer(cells, cfg, plain_cells=plain)
     state = tr.init(jax.random.PRNGKey(0))
     xs, ys = tr.shard_batch(*_batch(4, 32))
-    inv = collective_inventory(
-        tr._jit_step.lower(state, xs, ys).compile().as_text(), ops=OPS
-    )
+    compiled = tr._jit_step.lower(state, xs, ys).compile()
+    inv = collective_inventory(compiled.as_text(), ops=OPS)
     assert inv == {
         "collective-permute": 20,
         "all-gather": 2,
@@ -156,6 +158,21 @@ def test_sp_plus_lp_pipeline_inventory():
         "all-to-all": 0,
         "reduce-scatter": 2,
     }, inv
+
+    # The STACKED gate (the ROADMAP's composition item): the pipeline
+    # trainer contributes a spatial front delta (traced front halo
+    # shifts), the SP->LP join gather claim, and the exact stage-permute
+    # budget; compose() folds them into one window the full rule set is
+    # clean under — no hand-summed constants anywhere.
+    deltas = tr.collective_deltas(state, (4, 32, 32, 3))
+    assert [d.layer for d in deltas] == ["spatial", "spatial_join", "pipeline"]
+    front_shifts = tr.halo_shift_count(state, (4, 32, 32, 3))
+    assert front_shifts > 0
+    expected = compose(deltas)
+    assert expected.halo_shifts == front_shifts
+    assert expected.extra_permutes == tr.stage_permute_count()
+    assert expected.join_gathers == 2
+    _no_errors(analyze_compiled(compiled, expected=expected))
 
 
 def test_spatial_trainer_decomposed_overlap_keeps_permute_window(monkeypatch):
@@ -188,6 +205,6 @@ def test_spatial_trainer_decomposed_overlap_keeps_permute_window(monkeypatch):
 
     report = analyze_compiled(
         compiled,
-        expected=Expectations(tile_shape=cfg.tile_shape, halo_shifts=shifts),
+        expected=compose(tr.collective_deltas(state.params, (4, 32, 32, 3))),
     )
     _no_errors(report)

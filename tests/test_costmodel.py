@@ -1,0 +1,236 @@
+"""ISSUE 16 tentpole: the static communication cost model
+(:mod:`mpi4dl_tpu.analysis.costmodel`) on canned collective records —
+pricing formulas per op class, program-level prediction shape, the
+no-claim rule for sync-only programs, gauge publication through the
+metric catalog, the crosscheck severities, and the pure-JSON artifact
+mode. No jax, no compile: the live end-to-end path is exercised by
+``analyze costmodel`` (slow tier) and the bench extras."""
+
+import json
+
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.analysis.costmodel import (
+    DEFAULT_TOLERANCE,
+    INTERCONNECTS,
+    artifact_main,
+    collective_seconds,
+    crosscheck_cost_model,
+    predict_from_report,
+    predict_program,
+    publish_prediction,
+)
+
+ICI = INTERCONNECTS["ici"]
+CPU = INTERCONNECTS["cpu"]
+MB = 1024 * 1024
+
+
+def _rec(opcode, bytes_moved, is_async=False, compute_between=0):
+    return {"opcode": opcode, "bytes_moved": bytes_moved,
+            "is_async": is_async, "compute_between": compute_between}
+
+
+# -- pricing formulas ---------------------------------------------------------
+
+def test_interconnect_table_priors():
+    """The committed priors the ICI campaign falsifies: a TPU-v4-ish
+    torus link vs the shared-heap CPU 'link'."""
+    assert ICI.bandwidth_bytes_per_s == pytest.approx(100e9)
+    assert ICI.latency_s == pytest.approx(1e-6)
+    assert CPU.bandwidth_bytes_per_s == pytest.approx(10e9)
+    assert CPU.latency_s == pytest.approx(5e-6)
+    assert DEFAULT_TOLERANCE == 0.15
+
+
+def test_permute_is_one_hop():
+    t = collective_seconds("collective-permute", MB, ICI, 8)
+    assert t == pytest.approx(ICI.latency_s + MB / ICI.bandwidth_bytes_per_s)
+
+
+@pytest.mark.parametrize("op", ["all-gather", "reduce-scatter", "all-to-all"])
+def test_ring_ops_scale_with_device_count(op):
+    n = 8
+    t = collective_seconds(op, MB, ICI, n)
+    assert t == pytest.approx(
+        (n - 1) * ICI.latency_s
+        + (n - 1) / n * MB / ICI.bandwidth_bytes_per_s
+    )
+    # More devices → more latency terms, payload share → 1: monotone up.
+    assert collective_seconds(op, MB, ICI, 16) > t
+
+
+def test_all_reduce_doubles_the_ring():
+    """Ring all-reduce = reduce-scatter + all-gather phases."""
+    assert collective_seconds("all-reduce", MB, ICI, 8) == pytest.approx(
+        2 * collective_seconds("all-gather", MB, ICI, 8)
+    )
+
+
+def test_unknown_op_prices_one_full_payload_hop():
+    assert collective_seconds("quantum-entangle", MB, ICI, 8) == (
+        pytest.approx(collective_seconds("collective-permute", MB, ICI, 8))
+    )
+
+
+# -- program-level prediction -------------------------------------------------
+
+def test_sync_only_program_makes_no_overlap_claim():
+    """Every CPU-mesh program: collectives exist but none are async —
+    predicted achievable overlap 0.0 with the claim OFF, so the
+    crosscheck stays silent whatever the runtime measured (sync
+    collectives say nothing about what an async lowering could hide)."""
+    pred = predict_program(
+        [_rec("collective-permute", MB) for _ in range(4)],
+        interconnect="cpu",
+    )
+    assert pred["n_collectives"] == 4 and pred["n_async"] == 0
+    assert pred["overlap_claim"] is False
+    assert pred["overlap_ratio"] == 0.0
+    assert pred["comms_s"] == pytest.approx(
+        4 * collective_seconds("collective-permute", MB, CPU, 8)
+    )
+    assert pred["exposed_s"] == pytest.approx(pred["comms_s"])
+    assert crosscheck_cost_model(pred, measured_overlap=0.97) == []
+
+
+def test_async_with_compute_between_is_hideable():
+    """Achievable = async window AND compute already scheduled inside it
+    — an async pair with an empty window hides nothing (the T3 rule)."""
+    hidden = _rec("collective-permute", MB, is_async=True, compute_between=3)
+    empty = _rec("collective-permute", MB, is_async=True, compute_between=0)
+    sync = _rec("collective-permute", MB)
+    pred = predict_program([hidden, empty, sync], interconnect="ici")
+    one = collective_seconds("collective-permute", MB, ICI, 8)
+    assert pred["overlap_claim"] is True and pred["n_async"] == 2
+    assert pred["hideable_s"] == pytest.approx(one, abs=1e-9)
+    assert pred["comms_s"] == pytest.approx(3 * one, abs=1e-9)
+    assert pred["overlap_ratio"] == pytest.approx(1 / 3, abs=1e-4)
+    assert pred["per_op"]["collective-permute"]["count"] == 3
+
+
+def test_predict_from_report_reads_config():
+    d = {
+        "module_name": "m",
+        "config": {"program": "sp2x2_train", "n_devices": 4},
+        "collectives": [_rec("all-gather", MB)],
+    }
+    pred = predict_from_report(d, interconnect="ici")
+    assert pred["program"] == "sp2x2_train"
+    assert pred["n_devices"] == 4
+    assert pred["comms_s"] == pytest.approx(
+        collective_seconds("all-gather", MB, ICI, 4), abs=1e-9
+    )
+    # Bubble passthrough: the schedule model's number rides unmodified.
+    pred = predict_from_report(d, analytic_bubble=0.2)
+    assert pred["bubble_fraction"] == 0.2
+
+
+# -- gauges through the catalog ----------------------------------------------
+
+def test_publish_prediction_uses_cataloged_gauges():
+    reg = telemetry.MetricsRegistry()
+    pred = predict_program(
+        [_rec("collective-permute", MB, is_async=True, compute_between=2)],
+        interconnect="ici", analytic_bubble=0.2,
+    )
+    pred["program"] = "pipeline_gpipe"
+    publish_prediction(pred, reg)
+    labels = {"program": "pipeline_gpipe", "interconnect": "ici"}
+    assert reg.get("hlolint_predicted_comms_seconds").value(**labels) == (
+        pytest.approx(pred["comms_s"])
+    )
+    assert reg.get("hlolint_predicted_overlap_ratio").value(**labels) == 1.0
+    assert reg.get("hlolint_predicted_bubble_fraction").value(**labels) == 0.2
+
+
+# -- crosscheck severities ----------------------------------------------------
+
+def _claiming_pred(ratio, bubble=None):
+    return {"overlap_claim": True, "overlap_ratio": ratio,
+            "bubble_fraction": bubble}
+
+
+def test_crosscheck_measured_above_ceiling_is_an_error():
+    (f,) = crosscheck_cost_model(_claiming_pred(0.5), measured_overlap=0.8)
+    assert f.rule == "cost-model-crosscheck" and f.severity == "error"
+    assert "ceiling" in f.message
+
+
+def test_crosscheck_measured_below_ceiling_is_info():
+    (f,) = crosscheck_cost_model(_claiming_pred(0.9), measured_overlap=0.5)
+    assert f.severity == "info"
+
+
+def test_crosscheck_within_tolerance_is_clean():
+    assert crosscheck_cost_model(
+        _claiming_pred(0.6), measured_overlap=0.6 + DEFAULT_TOLERANCE / 2
+    ) == []
+
+
+def test_crosscheck_bubble_disagreement_is_an_error():
+    (f,) = crosscheck_cost_model(
+        _claiming_pred(0.0, bubble=0.2), measured_bubble=0.45,
+    )
+    assert f.severity == "error" and "bubble" in f.message
+    assert crosscheck_cost_model(
+        _claiming_pred(0.0, bubble=0.2), measured_bubble=0.21,
+    ) == []
+
+
+# -- artifact mode (pure JSON, in-process) ------------------------------------
+
+def test_artifact_main_prices_committed_reports(tmp_path, capsys):
+    rep = tmp_path / "report.json"
+    rep.write_text(json.dumps({
+        "module_name": "m",
+        "config": {"program": "sp2x2_train", "n_devices": 8},
+        "collectives": [_rec("collective-permute", MB)] * 20,
+    }))
+    out = tmp_path / "pred.json"
+    rc = artifact_main([str(rep), "--interconnect", "ici",
+                        "--json", str(out)])
+    assert rc == 0
+    assert "costmodel[sp2x2_train] ici" in capsys.readouterr().out
+    payload = json.loads(out.read_text())
+    (pred,) = payload["predictions"]
+    assert pred["source"] == str(rep)
+    assert pred["n_collectives"] == 20
+    assert pred["comms_s"] == pytest.approx(
+        20 * collective_seconds("collective-permute", MB, ICI, 8)
+    )
+
+
+def test_committed_ici_artifact_reprices_consistently():
+    """The committed campaign artifact (docs/artifacts/) must stay
+    internally consistent: every program entry carries the ici
+    interconnect, a sync-only no-claim (CPU-mesh compiles), and a
+    positive priced comms time — so real-hardware numbers have a
+    well-formed prediction to falsify."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "artifacts", "costmodel_ici_r01.json",
+    )
+    doc = json.load(open(path))
+    assert doc["interconnect"] == "ici" and doc["round"] == "r01"
+    assert set(doc["programs"]) >= {
+        "sp2x2_train", "pipeline_gpipe", "pipeline_1f1b",
+    }
+    for name, entry in doc["programs"].items():
+        pred = entry["prediction"]
+        assert pred["interconnect"] == "ici", name
+        assert pred["comms_s"] > 0, name
+        assert pred["overlap_claim"] is False, name
+        # The committed CPU-mesh crosscheck was clean — the campaign
+        # starts from a model the live gauges did not contradict.
+        assert entry["crosscheck"] == [], name
+        assert entry["lint_errors"] == [], name
+        assert entry["tolerance"] == DEFAULT_TOLERANCE, name
+        if name.startswith("pipeline_"):
+            assert pred["bubble_fraction"] > 0, name
+            assert pred["bubble_fraction"] == pytest.approx(
+                entry["measured"]["pipeline_bubble_fraction"]
+            ), name

@@ -1,0 +1,290 @@
+"""ISSUE 16 tentpole: the expectations algebra
+(:mod:`mpi4dl_tpu.analysis.expectations`).
+
+Three layers of pinning:
+
+1. **Composition laws** on pure deltas — all-silent → single-chip gate,
+   all-DP → pure-DP gate, silent∘communicating and conflicting tile
+   grids are type errors, communicating stacks sum their windows/exact
+   budgets/join claims.
+2. **Program-surface coverage** (the satellite): every footprint-ledger
+   program surface exposes ``collective_deltas()`` and its composition
+   reproduces today's hand-derived budget byte-for-byte — train pure-DP,
+   train SP, serve single-chip, serve sharded, serve tiled, and the
+   pipeline schedules (gpipe exact-2, 1f1b exact-6 stage permutes).
+   Construction-only: nothing compiles here (the compiled-HLO gates live
+   in test_collective_inventory / test_pipeline_lens / the serve tests).
+3. **No hand-summed budgets** (ast scan): outside
+   ``mpi4dl_tpu/analysis/``, no package source constructs
+   ``Expectations(...)`` directly — surfaces contribute deltas and
+   ``compose()`` derives the gate, so a new parallelism layer cannot
+   fork the budget math.
+"""
+
+import ast
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi4dl_tpu.analysis.expectations import (
+    CollectiveDelta,
+    compose,
+    data_parallel_delta,
+    pipeline_delta,
+    single_chip_delta,
+    spatial_delta,
+    spatial_join_delta,
+    tiled_delta,
+)
+from mpi4dl_tpu.analysis.rules import Expectations
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- 1. composition laws ------------------------------------------------------
+
+def test_all_silent_composes_to_single_chip_gate():
+    """Byte-for-byte the former hardcoded literal — dataclass equality,
+    every field at its default except single_chip."""
+    for deltas in ([single_chip_delta()], [tiled_delta()],
+                   [single_chip_delta(), tiled_delta()]):
+        exp = compose(*deltas)
+        assert dataclasses.asdict(exp) == dataclasses.asdict(
+            Expectations(single_chip=True)
+        )
+
+
+def test_all_dp_composes_to_pure_dp_gate():
+    exp = compose(data_parallel_delta())
+    assert dataclasses.asdict(exp) == dataclasses.asdict(
+        Expectations(pure_dp=True)
+    )
+    assert compose(data_parallel_delta(), data_parallel_delta()).pure_dp
+
+
+def test_silent_with_communicating_is_a_type_error():
+    with pytest.raises(ValueError, match="zero-collective"):
+        compose(single_chip_delta(), spatial_delta((2, 2), 12))
+    with pytest.raises(ValueError, match="zero-collective"):
+        compose(tiled_delta(), pipeline_delta(2))
+
+
+def test_conflicting_tile_grids_are_a_type_error():
+    with pytest.raises(ValueError, match="grid|tile"):
+        compose(spatial_delta((2, 2), 12), spatial_delta((4, 1), 8))
+
+
+def test_communicating_stack_sums_windows_budgets_and_joins():
+    exp = compose(
+        spatial_delta((2, 2), 12),
+        spatial_join_delta(2),
+        pipeline_delta(6),
+    )
+    assert exp.tile_shape == (2, 2)
+    assert exp.halo_shifts == 12
+    assert exp.extra_permutes == 6
+    assert exp.join_gathers == 2
+    assert exp.single_chip is False and exp.pure_dp is False
+    # DP rides along silently-on-the-permute-axis: it neither adds to
+    # the window nor disables the claim.
+    both = compose(spatial_delta((2, 2), 12), data_parallel_delta())
+    assert both.halo_shifts == 12 and both.pure_dp is False
+
+
+def test_compose_accepts_iterables_and_rejects_junk():
+    deltas = (spatial_delta((2, 2), 12), pipeline_delta(2))
+    assert compose(deltas) == compose(*deltas)
+    with pytest.raises(ValueError):
+        compose()
+    with pytest.raises((TypeError, ValueError)):
+        compose("not a delta")
+
+
+def test_constructors_validate_and_describe():
+    with pytest.raises(ValueError):
+        spatial_delta((2, 2), -1)
+    with pytest.raises(ValueError):
+        pipeline_delta(-2)
+    with pytest.raises(ValueError):
+        spatial_join_delta(-1)
+    d = spatial_delta((2, 2), 12)
+    assert isinstance(d, CollectiveDelta) and d.layer == "spatial"
+    assert "halo" in d.describe()
+
+
+def test_join_gathers_default_is_none_not_zero():
+    """The algebra only claims the join when a layer contributes it —
+    a None disables the join-gather-count rule, preserving byte-for-byte
+    equality with the pre-algebra gates at every unchanged site."""
+    assert Expectations().join_gathers is None
+    assert compose(spatial_delta((2, 2), 12)).join_gathers is None
+    assert compose(spatial_join_delta(2)).join_gathers == 2
+
+
+# -- 2. program-surface coverage ----------------------------------------------
+
+SIZE, N_SP = 32, 3
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v1
+    from mpi4dl_tpu.parallel.partition import init_cells
+
+    plain = get_resnet_v1(depth=8)
+    cells = get_resnet_v1(depth=8, spatial_cells=N_SP)
+    params = init_cells(
+        plain, jax.random.PRNGKey(0), jnp.zeros((1, SIZE, SIZE, 3))
+    )
+    stats = collect_batch_stats(
+        plain, params, [jnp.zeros((2, SIZE, SIZE, 3), jnp.float32)]
+    )
+    return plain, cells, params, stats
+
+
+def test_surface_train_pure_dp():
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.models.resnet import get_resnet_v1
+    from mpi4dl_tpu.train import Trainer
+
+    cfg = ParallelConfig(
+        batch_size=4, split_size=1, spatial_size=0, image_size=SIZE,
+        data_parallel=2,
+    )
+    tr = Trainer(get_resnet_v1(depth=8), num_spatial_cells=0, config=cfg)
+    state = tr.init(jax.random.PRNGKey(0), (4, SIZE, SIZE, 3))
+    deltas = tr.collective_deltas(state.params, (4, SIZE, SIZE, 3))
+    assert [d.layer for d in deltas] == ["data_parallel"]
+    assert dataclasses.asdict(compose(deltas)) == dataclasses.asdict(
+        Expectations(pure_dp=True)
+    )
+
+
+def test_surface_train_spatial():
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.models.resnet import get_resnet_v1
+    from mpi4dl_tpu.train import Trainer
+
+    cfg = ParallelConfig(
+        batch_size=4, split_size=1, spatial_size=1, num_spatial_parts=(4,),
+        slice_method="square", image_size=SIZE, data_parallel=1,
+    )
+    tr = Trainer(
+        get_resnet_v1(depth=8, spatial_cells=N_SP), num_spatial_cells=N_SP,
+        config=cfg, plain_cells=get_resnet_v1(depth=8),
+    )
+    state = tr.init(jax.random.PRNGKey(0), (4, SIZE, SIZE, 3))
+    (delta,) = tr.collective_deltas(state.params, (4, SIZE, SIZE, 3))
+    shifts = tr.halo_shift_count(state.params, (4, SIZE, SIZE, 3))
+    assert delta.layer == "spatial" and shifts > 0
+    exp = compose(delta)
+    assert exp.tile_shape == cfg.tile_shape == (2, 2)
+    assert exp.halo_shifts == shifts
+    assert exp.single_chip is False and exp.join_gathers is None
+
+
+def test_surface_serve_single_chip(small_model):
+    from mpi4dl_tpu.serve.engine import SingleChipPredictor
+
+    plain, _, params, stats = small_model
+    pred = SingleChipPredictor(
+        plain, params, stats, (SIZE, SIZE, 3), jnp.float32
+    )
+    assert [d.layer for d in pred.collective_deltas()] == ["single_chip"]
+    assert dataclasses.asdict(pred.expectations()) == dataclasses.asdict(
+        Expectations(single_chip=True)
+    )
+
+
+def test_surface_serve_sharded(small_model):
+    from mpi4dl_tpu.serve.sharded import serving_mesh_config
+    from mpi4dl_tpu.train import Trainer
+    from mpi4dl_tpu.serve.sharded import ShardedPredictor
+
+    plain, cells, params, stats = small_model
+    cfg = serving_mesh_config((2, 2), SIZE)
+    trainer = Trainer(
+        cells, num_spatial_cells=N_SP, config=cfg, plain_cells=plain
+    )
+    pred = ShardedPredictor(trainer, params, stats, (SIZE, SIZE, 3))
+    (delta,) = pred.collective_deltas()
+    assert delta.layer == "spatial"
+    exp = pred.expectations()
+    assert exp.tile_shape == (2, 2)
+    assert exp.halo_shifts == pred.halo_shifts() > 0
+
+
+def test_surface_serve_tiled(small_model):
+    from mpi4dl_tpu.serve.tiled import TiledPredictor
+
+    plain, _, params, stats = small_model
+    pred = TiledPredictor(plain, params, stats, (SIZE, SIZE, 3), 16)
+    assert [d.layer for d in pred.collective_deltas()] == ["tiled"]
+    assert dataclasses.asdict(pred.expectations()) == dataclasses.asdict(
+        Expectations(single_chip=True)
+    )
+
+
+@pytest.mark.parametrize("schedule,budget", [("gpipe", 2), ("1f1b", 6)])
+def test_surface_pipeline_schedules(schedule, budget):
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.models.resnet import get_resnet_v1
+    from mpi4dl_tpu.parallel.pipeline import PipelineTrainer
+
+    cfg = ParallelConfig(
+        batch_size=8, parts=4, split_size=2, spatial_size=0,
+        image_size=SIZE,
+    )
+    tr = PipelineTrainer(get_resnet_v1(depth=8), cfg, schedule=schedule)
+    state = tr.init(jax.random.PRNGKey(0))
+    deltas = tr.collective_deltas(state, (8, SIZE, SIZE, 3))
+    assert [d.layer for d in deltas] == ["pipeline"]
+    exp = compose(deltas)
+    assert exp.extra_permutes == tr.stage_permute_count() == budget
+    # The exact budget shifts BOTH window bounds: a pure-LP program's
+    # permute inventory must sit exactly at it (halo window is empty).
+    assert exp.halo_shifts == 0 and exp.single_chip is False
+
+
+# -- 3. no hand-summed budgets outside the algebra ----------------------------
+
+def test_no_expectations_constructed_outside_analysis():
+    """Every program surface derives its gate via collective_deltas +
+    compose; direct Expectations(...) construction (hand-summed budgets)
+    is confined to mpi4dl_tpu/analysis/ (the dataclass's home and the
+    rule engine's default). An ast scan, not a grep: docstring mentions
+    don't count, calls do."""
+    offenders = []
+    for dirpath, dirnames, filenames in os.walk(
+        os.path.join(REPO, "mpi4dl_tpu")
+    ):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        if os.path.basename(dirpath) == "analysis":
+            dirnames[:] = []
+            continue
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            tree = ast.parse(open(path, encoding="utf-8").read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = (
+                    f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else None
+                )
+                if name == "Expectations":
+                    offenders.append(
+                        f"{os.path.relpath(path, REPO)}:{node.lineno}"
+                    )
+    assert offenders == [], (
+        "hand-built Expectations outside mpi4dl_tpu/analysis/ — "
+        "contribute a CollectiveDelta and compose() instead: "
+        + ", ".join(offenders)
+    )

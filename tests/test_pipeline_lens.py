@@ -264,15 +264,13 @@ def test_live_permute_inventory_sits_at_the_budget(live_captures):
     INSIDE the stage-permute window — pinned exactly, since a pure-LP
     pipeline has zero halo shifts and the wire permutes have no dedupe
     slack. Linted from the fixture's compiled text (no recompile)."""
-    from mpi4dl_tpu.analysis import Expectations, analyze_hlo_text
+    from mpi4dl_tpu.analysis import analyze_hlo_text, compose, pipeline_delta
 
     _, caps = live_captures
     for schedule, (tr, _, hlo_text) in caps.items():
         rep = analyze_hlo_text(
             hlo_text,
-            expected=Expectations(
-                halo_shifts=0, extra_permutes=tr.stage_permute_count()
-            ),
+            expected=compose(pipeline_delta(tr.stage_permute_count())),
         )
         assert rep.inventory.get("collective-permute", 0) == (
             tr.stage_permute_count()

@@ -672,6 +672,21 @@ def full_stack(tmp_path_factory):
         reg, program="pipeline_gpipe",
     )
 
+    # Cost-model publisher (analysis/costmodel.py): same canned-publish
+    # pattern — the live pricing path is exercised by
+    # tests/test_costmodel.py and the bench extras.
+    from mpi4dl_tpu.analysis.costmodel import (
+        predict_program as _cm_predict, publish_prediction,
+    )
+
+    cm_pred = _cm_predict(
+        [{"opcode": "collective-permute", "bytes_moved": 1 << 20,
+          "is_async": True, "compute_between": 2}],
+        interconnect="ici", analytic_bubble=0.2,
+    )
+    cm_pred["program"] = "train_step"
+    publish_prediction(cm_pred, reg)
+
     events = telemetry.read_events(
         os.path.join(tdir, os.listdir(tdir)[0])
     )
