@@ -696,6 +696,107 @@ def _measure_fleet() -> dict:
             client.close()
 
 
+def _measure_multitenant() -> dict:
+    """Multi-tenant QoS extra (docs/SERVING.md "Multi-tenancy"): one
+    small engine, three closed-loop rounds —
+
+    - ``off``: tenancy disabled — the zero-overhead baseline;
+    - ``solo``: tenancy on, the victim tenant alone — its clean p99;
+    - ``flood``: a 10:1 bully:victim noisy-neighbor flood through the
+      deficit-weighted-round-robin batch fill.
+
+    bench-history trends ``victim_p99_ratio`` (flood p99 / solo p99,
+    INVERTED sign — a growing ratio means tenant isolation regressed)
+    and ``fairness_index`` (Jain's index over per-tenant served/offered,
+    normal sign — falling fairness regresses); ``overhead_pct`` records
+    the tenancy-on tax vs the off baseline (docs target: within 2%)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.utils import get_depth
+
+    size = 16
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=size // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+
+    def mk_engine(**kw):
+        return ServingEngine(
+            cells, params, stats, example_shape=(size, size, 3),
+            max_batch=8, max_queue=512, default_deadline_s=60.0, **kw
+        )
+
+    n = 512
+    eng_off = mk_engine()
+    eng_off.start()
+    try:
+        # Warm-up pass first: bucket compiles and allocator churn must
+        # not land inside either arm of the ON/OFF overhead comparison.
+        run_closed_loop(eng_off, 64, concurrency=32, deadline_s=60.0)
+        off = run_closed_loop(eng_off, n, concurrency=32, deadline_s=60.0)
+    finally:
+        eng_off.stop()
+
+    eng = mk_engine(tenants="victim=none,bully=none", registry=_REGISTRY)
+    eng.start()
+    try:
+        run_closed_loop(
+            eng, 64, concurrency=32, deadline_s=60.0,
+            tenant_mix={"victim": 1.0},
+        )
+        solo = run_closed_loop(
+            eng, n, concurrency=32, deadline_s=60.0,
+            tenant_mix={"victim": 1.0},
+        )
+        flood = run_closed_loop(
+            eng, n, concurrency=32, deadline_s=60.0,
+            tenant_mix={"bully": 10.0, "victim": 1.0},
+        )
+    finally:
+        eng.stop()
+
+    solo_p99 = solo["by_tenant"]["victim"]["latency_s"]["p99"]
+    flood_p99 = flood["by_tenant"]["victim"]["latency_s"]["p99"]
+    served = {t: rec["served"] for t, rec in flood["by_tenant"].items()}
+    offered = {"bully": 10.0, "victim": 1.0}
+    xs = [served[t] / offered[t] for t in served if t in offered]
+    jain = (
+        sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs)) if any(xs) else 0.0
+    )
+    on_rps = solo["throughput_rps"]
+    off_rps = off["throughput_rps"]
+    return {
+        "value": round(on_rps, 1),
+        "unit": "requests/sec with tenancy on (single tenant)",
+        "off_rps": round(off_rps, 1),
+        "overhead_pct": round((off_rps - on_rps) / off_rps * 100.0, 2),
+        # Noisy-neighbor isolation: how much the 10:1 flood inflates the
+        # victim's p99 over its solo baseline (1.0 == perfect isolation).
+        "victim_p99_ratio": round(flood_p99 / max(solo_p99, 1e-9), 3),
+        "victim_p99_ms": {
+            "solo": round(solo_p99 * 1e3, 2),
+            "flood": round(flood_p99 * 1e3, 2),
+        },
+        "fairness_index": round(jain, 4),
+        "served_by_tenant": served,
+        "deadline_misses": flood["deadline_misses"],
+        "rejected_quota": flood["rejected_quota"],
+    }
+
+
 def _measure_sp_overlap() -> dict:
     """SP 2×2 halo/compute-overlap A/B extra: run the spatially-
     partitioned train step with the monolithic AND the decomposed conv
@@ -1386,6 +1487,13 @@ def main():
     # -9): rps-through-the-fault, requeue count, recovery latency.
     if os.environ.get("BENCH_FLEET", "1") != "0":
         run_extra("fleet_2replica", _measure_fleet, est_seconds=240.0)
+
+    # Multi-tenant QoS (tenancy subsystem): noisy-neighbor victim p99
+    # ratio + Jain's fairness index under a 10:1 flood, and the
+    # tenancy-on overhead vs off — bench-history trends the ratio
+    # INVERTED and fairness normal-sign.
+    if os.environ.get("BENCH_MULTITENANT", "1") != "0":
+        run_extra("multitenant", _measure_multitenant, est_seconds=150.0)
 
     # SP 2x2 halo/compute overlap A/B (CPU-mesh subprocess): both conv
     # impls' measured trace_overlap_ratio + step time in one round, so

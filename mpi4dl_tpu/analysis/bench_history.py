@@ -177,6 +177,17 @@ def extract_series(result: dict) -> "dict[str, float]":
                 rps = rec.get("rps")
                 if isinstance(rps, (int, float)):
                     out[f"{name}.sched_rps[{arm}]"] = float(rps)
+        # Multi-tenant QoS extra: victim p99 inflation under the 10:1
+        # noisy-neighbor flood (INVERTED sign — a growing ratio means
+        # tenant isolation regressed) and Jain's fairness index over
+        # per-tenant served/offered (normal sign — falling fairness
+        # fails). The tenancy-on throughput rides the generic ``value``.
+        vr = entry.get("victim_p99_ratio")
+        if isinstance(vr, (int, float)):
+            out[f"{name}.victim_p99_ratio"] = float(vr)
+        fi = entry.get("fairness_index")
+        if isinstance(fi, (int, float)):
+            out[f"{name}.fairness_index"] = float(fi)
         # Overlap A/B extras (sp2x2_overlap, serving_sharded): per-arm
         # measured overlap ratio (falling fails), SP train-step time
         # (growing fails), and — serving arms only — per-request p99
@@ -221,7 +232,10 @@ def lower_is_better(key: str) -> bool:
     drift is the failure, a shrunk one the improvement — the inverse of
     every throughput/capability/overlap-ratio series
     (``trace_overlap_ratio`` and ``predicted_overlap_ratio`` keep the
-    normal direction: FALLING overlap fails CI)."""
+    normal direction: FALLING overlap fails CI). The multitenant
+    ``victim_p99_ratio`` is inverted too — a growing victim tail under
+    the flood is lost isolation — while ``fairness_index`` keeps the
+    normal direction."""
     return (
         "peak_hbm_bytes" in key
         or ".recovery_s" in key
@@ -232,6 +246,7 @@ def lower_is_better(key: str) -> bool:
         or ".bubble_fraction[" in key
         or ".predicted_comms_s[" in key
         or key.endswith(".overlap_drift")
+        or key.endswith(".victim_p99_ratio")
     )
 
 

@@ -203,6 +203,16 @@ def worst_traces(events, n: int = 10) -> "list[dict]":
             ),
             None,
         )
+        # Likewise the tenant (multi-tenant fleets stamp it on engine
+        # and router segments): a noisy-neighbor row names WHO was slow.
+        tenant = next(
+            (
+                ev.get("attrs", {}).get("tenant")
+                for ev in segments
+                if ev.get("attrs", {}).get("tenant")
+            ),
+            None,
+        )
         rows.append({
             "trace_id": tid,
             "e2e_s": e2e,
@@ -211,6 +221,7 @@ def worst_traces(events, n: int = 10) -> "list[dict]":
             "segments": len(segments),
             "outcome": slowest.get("attrs", {}).get("outcome"),
             "slo_class": slo_class,
+            "tenant": tenant,
             "tail_sampled": tid in samples,
             "exemplar": tid in exemplars,
         })
@@ -256,6 +267,7 @@ def _print_trace(rep: dict) -> None:
         print(
             "  tail.sample: "
             f"slo_class={a.get('slo_class')} "
+            f"tenant={a.get('tenant')} "
             f"threshold={_fmt_ms(a.get('threshold_s'))} "
             f"queue_depth_at_submit={a.get('queue_depth_at_submit')} "
             f"bucket={a.get('bucket')} batch_size={a.get('batch_size')} "
@@ -337,13 +349,15 @@ def main(argv=None) -> int:
         return 0
     print(
         f"{'e2e':>12} {'dominant phase':<16} {'dom time':>12} "
-        f"{'class':<10} {'seg':>3} {'tail?':>5} {'exemplar?':>9}  trace_id"
+        f"{'class':<10} {'tenant':<10} {'seg':>3} {'tail?':>5} "
+        f"{'exemplar?':>9}  trace_id"
     )
     for r in rows:
         print(
             f"{_fmt_ms(r['e2e_s']):>12} {r['dominant_phase']:<16} "
             f"{_fmt_ms(r['dominant_s']):>12} "
-            f"{(r['slo_class'] or '-'):<10} {r['segments']:>3} "
+            f"{(r['slo_class'] or '-'):<10} "
+            f"{(r['tenant'] or '-'):<10} {r['segments']:>3} "
             f"{'yes' if r['tail_sampled'] else '-':>5} "
             f"{'yes' if r['exemplar'] else '-':>9}  {r['trace_id']}"
         )

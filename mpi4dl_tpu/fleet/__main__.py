@@ -62,12 +62,15 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SPEC",
                    help="fault injection, repeatable: "
                         "ACTION[:TARGET][=SECONDS][@AT] with actions "
-                        "kill, wedge, blackhole, delay-scrape, delay — "
-                        "e.g. kill:1@1.5 (SIGKILL replica 1, 1.5s into "
-                        "load), kill:router (SIGKILL router 0 — the "
-                        "successor replays its journal), or "
+                        "kill, wedge, blackhole, delay-scrape, delay, "
+                        "flood — e.g. kill:1@1.5 (SIGKILL replica 1, "
+                        "1.5s into load), kill:router (SIGKILL router 0 "
+                        "— the successor replays its journal), "
                         "delay:1=0.3 (straggler: slow replica 1's "
-                        "serving path by 0.3s per batch)")
+                        "serving path by 0.3s per batch), or "
+                        "flood:bulk=500@2 (noisy neighbor: offer 500 "
+                        "rps as tenant 'bulk' for a 2s burst — needs "
+                        "--tenants naming that tenant)")
     p.add_argument("--plan", action="store_true",
                    help="print the fleet plan as JSON and exit without "
                         "spawning anything (pure dispatch)")
@@ -119,6 +122,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--class-mix", default=None, metavar="MIX",
                    help="loadgen class mix NAME:WEIGHT[:DEADLINE], "
                         "comma-separated; report carries by_class")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant admission on EVERY router and "
+                        "worker engine: NAME=RPS:BURST[:WEIGHT]"
+                        "[@CLASSES] comma-separated ('NAME=none' = "
+                        "unlimited); over-quota floods shed at the "
+                        "front door with retry_after_s before taking "
+                        "queue slots. NOTE each router refills its own "
+                        "buckets, so R router processes admit up to "
+                        "R x the configured rate per tenant")
+    p.add_argument("--tenant-mix", default=None, metavar="MIX",
+                   help="loadgen tenant mix NAME:WEIGHT, comma-"
+                        "separated; report carries by_tenant")
     p.add_argument("--queue-full-retries", type=int, default=0)
     # observability
     p.add_argument("--metrics-port", type=int, default=None,
@@ -142,8 +157,25 @@ def plan(args) -> dict:
     from mpi4dl_tpu.fleet.frontdoor import router_cmd
 
     ops = parse_chaos_specs(args.chaos)
+    tenant_names = None
+    if args.tenants:
+        from mpi4dl_tpu.tenancy.model import parse_tenants
+
+        tenant_names = {t.name for t in parse_tenants(args.tenants)}
     for op in ops:
-        if op.domain == "router":
+        if op.domain == "tenant":
+            if tenant_names is None:
+                raise ValueError(
+                    f"chaos flood targets tenant {op.tenant!r} but no "
+                    "--tenants spec declares any tenants (the flood "
+                    "drill needs a quota to shed against)"
+                )
+            if op.tenant not in tenant_names:
+                raise ValueError(
+                    f"chaos flood tenant {op.tenant!r} not in --tenants "
+                    f"(configured: {sorted(tenant_names)})"
+                )
+        elif op.domain == "router":
             if op.target >= max(args.routers, 0):
                 raise ValueError(
                     f"chaos target router{op.target} outside --routers "
@@ -184,6 +216,8 @@ def _worker_args(args) -> "list[str]":
         out += ["--telemetry-dir", args.telemetry_dir]
     if args.slo_classes:
         out += ["--slo-classes", args.slo_classes]
+    if args.tenants:
+        out += ["--tenants", args.tenants]
     return out
 
 
@@ -200,6 +234,8 @@ def _router_args(args) -> "list[str]":
         out += ["--telemetry-dir", args.telemetry_dir]
     if args.slo_classes:
         out += ["--slo-classes", args.slo_classes]
+    if args.tenants:
+        out += ["--tenants", args.tenants]
     return out
 
 
@@ -263,6 +299,7 @@ def main(argv=None) -> int:
             inflight_per_replica=args.inflight_per_replica,
             telemetry_dir=args.telemetry_dir,
             slo_classes=args.slo_classes,
+            tenants=args.tenants,
         )
     federation = None
     if not args.no_federation:
@@ -335,13 +372,42 @@ def main(argv=None) -> int:
                 telemetry_dir=args.telemetry_dir,
             )
 
-        monkey = ChaosMonkey(parse_chaos_specs(args.chaos), sup)
+        def _flood(op):
+            # Noisy-neighbor injector: a fixed-length open-loop burst
+            # offered THROUGH the front door under the flood tenant,
+            # concurrent with the main load run. The returned outcome
+            # counts are the drill's evidence: a healthy quota sheds
+            # most of the burst as rejected_quota.
+            from mpi4dl_tpu.fleet.chaos import FLOOD_DURATION_S
+            from mpi4dl_tpu.serve.loadgen import TenantMix
+
+            rep = run_open_loop(
+                target, rate_rps=op.rps, duration_s=FLOOD_DURATION_S,
+                deadline_s=args.deadline_ms / 1e3,
+                tenant_mix=TenantMix({op.tenant: 1.0}),
+            )
+            return {
+                "duration_s": FLOOD_DURATION_S,
+                "offered": rep["offered"],
+                "served": rep["served"],
+                "rejected_quota": rep["rejected_quota"],
+                "rejected_queue_full": rep["rejected_queue_full"],
+                "deadline_misses": rep["deadline_misses"],
+                "errors": rep["errors"],
+            }
+
+        monkey = ChaosMonkey(parse_chaos_specs(args.chaos), sup,
+                             flood=_flood)
         monkey.start()
         mix_kw = {}
         if args.class_mix:
             from mpi4dl_tpu.serve.loadgen import ClassMix
 
             mix_kw["class_mix"] = ClassMix.parse(args.class_mix)
+        if args.tenant_mix:
+            from mpi4dl_tpu.serve.loadgen import TenantMix
+
+            mix_kw["tenant_mix"] = TenantMix.parse(args.tenant_mix)
         if args.mode == "closed":
             report["loadgen"] = run_closed_loop(
                 target, args.requests, concurrency=args.concurrency,

@@ -22,7 +22,12 @@ operator can rehearse them against a live fleet:
   engine dispatches (the STRAGGLER shape: the replica stays healthy and
   keeps serving, just slowly — only the federation-side
   ``fleet_replica_skew`` scoring names it; docs/OBSERVABILITY.md "Tail
-  forensics").
+  forensics");
+- ``flood`` — offer a burst of EXTRA traffic under a named tenant (the
+  NOISY-NEIGHBOR shape: the front door's token-bucket quota must shed
+  the flood with ``retry_after_s`` before it occupies queue slots, and
+  the deficit-weighted fill must hold the victim tenants' p99 —
+  docs/SERVING.md "Multi-tenancy").
 
 Spec grammar (``--chaos``, repeatable)::
 
@@ -35,14 +40,17 @@ Spec grammar (``--chaos``, repeatable)::
     wedge:0@2.5     wedge replica 0's batcher 2.5s into the load run
     delay-scrape:1=3@2   delay r1's /snapshotz by 3s from t=+2s
     delay:1=0.3@2   slow r1's serving path by 0.3s/batch from t=+2s
+    flood:bulk=500@2     offer 500 rps AS TENANT 'bulk' from t=+2s
+                         (a fixed 2s burst through the front door)
 
 ``TARGET`` is the replica *slot index* (default 0) — or
 ``router[:INDEX]`` to target a front-door router process instead
 (``kill`` only: routers have no in-process ``/chaos`` surface; their
-failure mode IS hard death). ``AT`` is seconds after the load run
-starts; ``=SECONDS`` (delay / delay-scrape) is the added latency.
-Parsing is pure stdlib — ``--plan`` dispatch and the CLI smoke never
-touch a backend.
+failure mode IS hard death) — or, for ``flood``, the tenant NAME to
+flood as. ``AT`` is seconds after the load run starts; ``=SECONDS``
+(delay / delay-scrape) is the added latency, and ``=RPS`` (flood) is
+the burst's offered rate. Parsing is pure stdlib — ``--plan`` dispatch
+and the CLI smoke never touch a backend.
 """
 
 from __future__ import annotations
@@ -52,14 +60,16 @@ import re
 import threading
 import time
 
-ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape", "delay")
+ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape", "delay", "flood")
 
 _SPEC_RE = re.compile(
     r"^(?P<action>[a-z-]+)"
-    r"(?::(?P<target>router(?::\d+)?|\d+))?"
+    r"(?::(?P<target>router(?::\d+)?|\d+|[a-z][a-z0-9_]*))?"
     r"(?:=(?P<seconds>\d+(?:\.\d+)?))?"
     r"(?:@(?P<at>\d+(?:\.\d+)?)s?)?$"
 )
+
+FLOOD_DURATION_S = 2.0  # every flood burst is a fixed-length window
 
 
 @dataclasses.dataclass
@@ -70,7 +80,9 @@ class ChaosOp:
     target: int = 0        # slot index within the target domain
     at_s: float = 1.0      # seconds after the load run starts
     seconds: float = 3.0   # delay-scrape only: added latency
-    domain: str = "replica"  # "replica" | "router" (the failure domain)
+    domain: str = "replica"  # "replica" | "router" | "tenant"
+    tenant: str = ""       # flood only: the tenant to flood as
+    rps: float = 0.0       # flood only: the burst's offered rate
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -78,7 +90,7 @@ class ChaosOp:
                 f"unknown chaos action {self.action!r}; expected one of "
                 f"{ACTIONS}"
             )
-        if self.domain not in ("replica", "router"):
+        if self.domain not in ("replica", "router", "tenant"):
             raise ValueError(f"unknown chaos domain {self.domain!r}")
         if self.domain == "router" and self.action != "kill":
             raise ValueError(
@@ -86,10 +98,23 @@ class ChaosOp:
                 f"{self.action!r}): routers have no /chaos surface — "
                 "their failure mode is hard death"
             )
+        if (self.action == "flood") != (self.domain == "tenant"):
+            raise ValueError(
+                "flood is the only tenant-domain chaos action; spell it "
+                "flood:TENANT=RPS[@AT] (e.g. flood:bulk=500@2)"
+            )
+        if self.action == "flood" and (not self.tenant or self.rps <= 0):
+            raise ValueError(
+                f"flood needs a tenant name and a positive rate: "
+                f"flood:TENANT=RPS[@AT], got tenant={self.tenant!r} "
+                f"rps={self.rps!r}"
+            )
         if self.target < 0 or self.at_s < 0 or self.seconds <= 0:
             raise ValueError(f"invalid chaos op: {self}")
 
     def describe(self) -> str:
+        if self.action == "flood":
+            return f"flood:{self.tenant}={self.rps:g}rps@+{self.at_s:g}s"
         extra = (
             f"={self.seconds:g}s"
             if self.action in ("delay-scrape", "delay") else ""
@@ -112,13 +137,29 @@ def parse_chaos_spec(spec: str) -> ChaosOp:
         )
     kw = {"action": m.group("action")}
     target = m.group("target")
+    if kw["action"] == "flood":
+        # flood:TENANT=RPS — TARGET is a tenant name, =SECONDS is rps.
+        kw["domain"] = "tenant"
+        kw["tenant"] = target or ""
+        if m.group("seconds") is not None:
+            kw["rps"] = float(m.group("seconds"))
+        if m.group("at") is not None:
+            kw["at_s"] = float(m.group("at"))
+        return ChaosOp(**kw)
     if target is not None:
         if target.startswith("router"):
             kw["domain"] = "router"
             _, _, idx = target.partition(":")
             kw["target"] = int(idx) if idx else 0
         else:
-            kw["target"] = int(target)
+            try:
+                kw["target"] = int(target)
+            except ValueError:
+                raise ValueError(
+                    f"chaos target {target!r} must be a replica index or "
+                    f"router[:N] for action {kw['action']!r} (tenant-name "
+                    "targets belong to flood:TENANT=RPS)"
+                ) from None
     if m.group("at") is not None:
         kw["at_s"] = float(m.group("at"))
     if m.group("seconds") is not None:
@@ -130,12 +171,24 @@ def parse_chaos_specs(specs) -> "list[ChaosOp]":
     return [parse_chaos_spec(s) for s in specs or ()]
 
 
-def inject(op: ChaosOp, supervisor) -> dict:
+def inject(op: ChaosOp, supervisor, flood=None) -> dict:
     """Apply one op against a live fleet NOW. ``kill`` goes straight to
     the OS (the point is that the victim gets no say); the soft faults
     go through the victim's own ``/chaos`` endpoint. ``domain="router"``
-    targets a front-door router slot instead of a replica. Returns a
-    record of what was done (the CLI report embeds it)."""
+    targets a front-door router slot instead of a replica; ``flood``
+    calls the caller-supplied ``flood(op)`` injector (the fleet CLI
+    wires a front-door open-loop burst) and embeds what it returns.
+    Returns a record of what was done (the CLI report embeds it)."""
+    if op.action == "flood":
+        if flood is None:
+            raise ValueError(
+                "flood chaos needs a traffic injector (the fleet CLI "
+                "wires one); none was provided"
+            )
+        record = {"op": op.describe(), "tenant": op.tenant,
+                  "rps": op.rps, "ts": time.time()}
+        record.update(flood(op) or {})
+        return record
     if op.domain == "router":
         slot = supervisor.router_slot_by_index(op.target)
         if slot is None:
@@ -174,9 +227,10 @@ class ChaosMonkey:
     (a drill against an already-dead replica must not kill the drill
     runner). ``log`` holds what actually happened."""
 
-    def __init__(self, ops, supervisor):
+    def __init__(self, ops, supervisor, flood=None):
         self.ops = sorted(ops, key=lambda o: o.at_s)
         self.supervisor = supervisor
+        self.flood = flood  # flood-op injector: op -> record dict
         self.log: "list[dict]" = []
         self._stop_evt = threading.Event()
         self._thread: "threading.Thread | None" = None
@@ -196,7 +250,8 @@ class ChaosMonkey:
             if delay > 0 and self._stop_evt.wait(delay):
                 return
             try:
-                self.log.append(inject(op, self.supervisor))
+                self.log.append(inject(op, self.supervisor,
+                                       flood=self.flood))
             except Exception as e:  # noqa: BLE001 — a failed injection
                 # is drill data, not a drill crash
                 self.log.append({

@@ -139,6 +139,7 @@ def _submit_server(router, port: int) -> ThreadingHTTPServer:
         DrainedError,
         QueueFullError,
     )
+    from mpi4dl_tpu.tenancy.model import QuotaExceededError
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, status: int, payload: dict) -> None:
@@ -205,7 +206,28 @@ def _submit_server(router, port: int) -> ThreadingHTTPServer:
                     trace_id=req.get("trace_id"),
                     slo_class=req.get("slo_class"),
                     tiled=tiled,
+                    tenant=req.get("tenant"),
+                    retried=bool(req.get("retried")),
                 )
+            except QuotaExceededError as e:
+                # Front-door quota shed: same 429 status as queue-full,
+                # distinguishable by error kind, retry_after_s = the
+                # token bucket's refill time.
+                self._reply(429, {
+                    "ok": False, "error": "quota_exceeded",
+                    "retry_after_s": e.retry_after_s,
+                    "tenant": e.tenant,
+                    "slo_class": e.slo_class,
+                    "shed": True,
+                })
+                return
+            except ValueError as e:
+                # Unknown tenant / class outside the tenant's allowlist
+                # / bad shape: the caller's bug, not fleet pressure.
+                self._reply(400, {
+                    "ok": False, "error": f"{type(e).__name__}: {e}",
+                })
+                return
             except QueueFullError as e:
                 self._reply(429, {
                     "ok": False, "error": "queue_full",
@@ -397,6 +419,7 @@ class RouterSetClient:
         deadline_s: "float | None" = None,
         trace_id: "str | None" = None,
         slo_class: "str | None" = None,
+        tenant: "str | None" = None,
     ):
         from concurrent.futures import Future
 
@@ -433,15 +456,17 @@ class RouterSetClient:
         )
         t = threading.Thread(
             target=self._run_one,
-            args=(fut, x, tid, float(ddl), slo_class, start_at),
+            args=(fut, x, tid, float(ddl), slo_class, tenant, start_at),
             name="mpi4dl-routerset-req", daemon=True,
         )
         t.start()
         return fut
 
-    def _run_one(self, fut, x, tid, deadline_s, slo_class, start_at) -> None:
+    def _run_one(self, fut, x, tid, deadline_s, slo_class, tenant,
+                 start_at) -> None:
         from mpi4dl_tpu.fleet.router import FleetRequestError
         from mpi4dl_tpu.serve.engine import DeadlineExceededError
+        from mpi4dl_tpu.tenancy.model import QuotaExceededError
 
         deadline = time.monotonic() + deadline_s
         n = len(self._routers)
@@ -477,12 +502,27 @@ class RouterSetClient:
                     logits, payload = client.predict(
                         x, tid, deadline_s=remaining,
                         timeout_s=remaining + 1.0, slo_class=slo_class,
+                        tenant=tenant,
                         # After any unreachable bounce the first attempt
                         # MAY have executed — the router must probe the
                         # served-caches before dispatching again.
                         retried=failovers > 0,
                     )
                 except ReplicaQueueFull as e:
+                    if e.kind == "quota_exceeded":
+                        # Typed quota shed, surfaced to the caller with
+                        # the bucket's refill hint. No failover: every
+                        # router refills its own buckets, so shopping
+                        # the request around would multiply the
+                        # tenant's effective quota.
+                        fut.failovers = failovers
+                        fut.trace_id = tid
+                        fut.set_exception(QuotaExceededError(
+                            str(e), tenant=e.tenant or tenant or "default",
+                            retry_after_s=e.retry_after_s,
+                            slo_class=slo_class,
+                        ))
+                        return
                     last_error = e
                     with self._lock:
                         self._counts["queue_full_retries"] += 1
@@ -601,6 +641,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds replay parks orphans polling the "
                         "replicas' served-caches before re-dispatching")
     p.add_argument("--slo-classes", default=None, metavar="SPEC")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="tenant quota/weight specs "
+                        "(NAME=RPS:BURST[:WEIGHT][@CLASSES], comma-"
+                        "separated; NAME=none = unlimited). Each router "
+                        "refills its own buckets: with R routers a "
+                        "tenant's effective front-door rate is R x its "
+                        "spec")
     p.add_argument("--telemetry-dir", default=None)
     p.add_argument("--replica", action="append", default=[],
                    metavar="NAME=PREDICT_URL[,HEALTH_URL]",
@@ -632,6 +679,7 @@ def main(argv=None) -> int:
         health_interval_s=args.health_interval,
         telemetry_dir=args.telemetry_dir,
         slo_classes=args.slo_classes,
+        tenants=args.tenants,
         journal_path=journal_path,
         replay_grace_s=args.replay_grace,
         load_slack=args.load_slack if args.load_slack >= 0 else None,

@@ -58,6 +58,7 @@ class JournalOrphan:
     slo_class: "str | None"
     router_epoch: int
     tiled: bool = False  # re-dispatch to /predict_tiled, not /predict
+    tenant: "str | None" = None  # re-dispatch under the same tenant
 
     def remaining_s(self, now: "float | None" = None) -> float:
         return self.deadline_wall - (time.time() if now is None else now)
@@ -125,6 +126,7 @@ def scan(path: str, now: "float | None" = None) -> JournalScan:
             slo_class=ev.get("slo_class"),
             router_epoch=int(ev.get("router_epoch", 0)),
             tiled=bool(ev.get("tiled", False)),
+            tenant=ev.get("tenant"),
         ))
     return JournalScan(
         orphans=orphans, completed=completed, expired=expired,
@@ -164,6 +166,7 @@ class RouterJournal:
         deadline_remaining_s: float,
         slo_class: "str | None" = None,
         tiled: bool = False,
+        tenant: "str | None" = None,
     ) -> None:
         self._append({
             "kind": "accept",
@@ -176,6 +179,7 @@ class RouterJournal:
             "deadline_wall": time.time() + float(deadline_remaining_s),
             "slo_class": slo_class,
             "tiled": bool(tiled),
+            "tenant": tenant,
             "router_epoch": self.router_epoch,
         })
 

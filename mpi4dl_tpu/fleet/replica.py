@@ -56,12 +56,26 @@ class ReplicaUnreachable(ReplicaError):
 
 
 class ReplicaQueueFull(ReplicaError):
-    """The replica's own admission control bounced the request."""
+    """The replica's own admission control bounced the request.
+
+    kind: the structured 429 body's ``error`` field — ``"queue_full"``
+        (a physically full queue / burn-rate shed) or
+        ``"quota_exceeded"`` (the tenant's token bucket is empty;
+        ``retry_after_s`` is then the bucket's refill time and
+        ``tenant`` names who to bill). The router-set client surfaces
+        quota bounces as typed
+        :class:`~mpi4dl_tpu.tenancy.QuotaExceededError` instead of
+        failing over — each router refills its own buckets, so retrying
+        elsewhere would multiply the tenant's effective quota."""
 
     def __init__(self, msg: str, replica: str = "",
-                 retry_after_s: "float | None" = None):
+                 retry_after_s: "float | None" = None,
+                 kind: str = "queue_full",
+                 tenant: "str | None" = None):
         super().__init__(msg, replica)
         self.retry_after_s = retry_after_s
+        self.kind = kind
+        self.tenant = tenant
 
 
 class ReplicaDeadline(ReplicaError):
@@ -110,6 +124,7 @@ class ReplicaClient:
         slo_class: "str | None" = None,
         retried: bool = False,
         tiled: bool = False,
+        tenant: "str | None" = None,
     ) -> "tuple[np.ndarray, dict]":
         """One blocking predict RPC; returns ``(logits, payload)`` or
         raises one of the typed errors above. ``slo_class`` propagates
@@ -132,6 +147,8 @@ class ReplicaClient:
         }
         if slo_class is not None:
             payload["slo_class"] = str(slo_class)
+        if tenant is not None:
+            payload["tenant"] = str(tenant)
         if retried:
             payload["retried"] = True
         try:
@@ -149,6 +166,7 @@ class ReplicaClient:
                 raise ReplicaQueueFull(
                     f"{self.name}: {kind}", self.name,
                     retry_after_s=err.get("retry_after_s"),
+                    kind=str(kind), tenant=err.get("tenant"),
                 ) from None
             if e.code == 504:
                 raise ReplicaDeadline(

@@ -63,6 +63,12 @@ from mpi4dl_tpu.fleet.replica import (
     ReplicaUnreachable,
 )
 from mpi4dl_tpu.profiling import percentiles
+from mpi4dl_tpu.tenancy.dedupe import pin_order
+from mpi4dl_tpu.tenancy.model import (
+    QuotaExceededError,
+    TenantAdmission,
+    normalize_tenants,
+)
 
 
 class FleetRequestError(RuntimeError):
@@ -102,11 +108,12 @@ class _Record:
         "x", "submit_t", "deadline", "future", "trace_id", "slo_class",
         "lock", "state", "epoch", "attempts", "history",
         "first_dispatch_t", "last_error", "replayed", "tiled",
-        "rpc_slo_class",
+        "rpc_slo_class", "tenant", "retried", "probed",
     )
 
     def __init__(self, x, submit_t, deadline, future, trace_id,
-                 slo_class=None, tiled=False, rpc_slo_class=None):
+                 slo_class=None, tiled=False, rpc_slo_class=None,
+                 tenant=None, retried=False):
         self.x = x
         self.submit_t = submit_t
         self.deadline = deadline
@@ -122,6 +129,16 @@ class _Record:
         self.last_error: "Exception | None" = None
         self.replayed = False
         self.tiled = bool(tiled)
+        # Tenancy + exactly-once context: the admitted tenant rides the
+        # replica RPC and every span; `retried` marks a request some
+        # EARLIER attempt may already have executed (client failover
+        # retry, or journal replay) — dispatch must probe the fleet's
+        # served-caches first and then pin to the rendezvous replica
+        # (tenancy/dedupe.py). `probed` makes the fan-out probe
+        # once-per-record.
+        self.tenant = tenant
+        self.retried = bool(retried)
+        self.probed = False
         # What rides the replica RPC: for plain requests the router's
         # resolved class (worker engines declare the same classes); for
         # tiled requests only an EXPLICIT caller class — the tiled
@@ -248,6 +265,7 @@ class Router:
         telemetry_dir: "str | None" = None,
         slo_classes=None,
         shed_queue_ratio: float = 0.5,
+        tenants=None,
         name: str = "router",
         journal_path: "str | None" = None,
         journal_fsync: bool = True,
@@ -299,6 +317,17 @@ class Router:
             telemetry.declare(self.registry, "serve_class_shed_total")
             if self._feedback is not None else None
         )
+        # Front-door quota admission (tenancy subsystem): each router
+        # refills its OWN token buckets at the configured per-tenant
+        # rate — with R routers a tenant's effective front-door rate is
+        # R x its spec (documented in docs/SERVING.md); the engine-edge
+        # buckets are the authoritative per-replica bound. None = off.
+        self._tenants = normalize_tenants(tenants)
+        self._admission = (
+            TenantAdmission(self._tenants, registry=self.registry)
+            if self._tenants is not None
+            else None
+        )
 
         self._m_requests = telemetry.declare(
             self.registry, "fleet_requests_total"
@@ -336,6 +365,7 @@ class Router:
         self._counts = {
             "submitted": 0, "served": 0, "failed": 0,
             "rejected_queue_full": 0, "rejected_deadline": 0,
+            "rejected_quota": 0,
             "drained": 0, "requeued": 0, "shed": 0, "replayed": 0,
         }
         self._latencies: "list[float]" = []
@@ -434,6 +464,8 @@ class Router:
         trace_id: "str | None" = None,
         slo_class: "str | None" = None,
         tiled: bool = False,
+        tenant: "str | None" = None,
+        retried: bool = False,
     ):
         """Admit one request; returns a ``Future``. Mirrors
         :meth:`ServingEngine.submit` (queue-full/deadline semantics,
@@ -469,6 +501,22 @@ class Router:
             )
         if self._stopping:
             raise RuntimeError("router is stopped")
+        # Front-door quota: over-quota floods shed with the bucket's
+        # refill time as the retry hint BEFORE occupying a router queue
+        # slot (QuotaExceededError, typed; never forwarded to a
+        # replica). With tenancy off the name is carried to spans/RPCs
+        # unvalidated.
+        if self._admission is not None:
+            try:
+                ten = self._admission.admit(tenant, slo_class=cls.name)
+            except QuotaExceededError:
+                with self._lock:
+                    self._counts["rejected_quota"] += 1
+                self._m_requests.inc(outcome="rejected_quota")
+                raise
+            tenant_name = ten.name
+        else:
+            tenant_name = tenant or "default"
         now = time.monotonic()
         if deadline_s is None:
             deadline_s = (
@@ -485,6 +533,7 @@ class Router:
             rpc_slo_class=(
                 str(slo_class) if slo_class is not None else None
             ),
+            tenant=tenant_name, retried=retried,
         )
         with self._cond:
             depth = len(self._pending)
@@ -524,7 +573,7 @@ class Router:
             # the replica-side idempotency cache dedupes the overlap.
             self._journal.accept(
                 rec.trace_id, x, deadline_s, slo_class=cls.name,
-                tiled=tiled,
+                tiled=tiled, tenant=tenant_name,
             )
         with self._lock:
             self._counts["submitted"] += 1
@@ -537,6 +586,8 @@ class Router:
         out["latency_s"] = percentiles(lat)
         out["queue_depth"] = len(self._pending)
         out["replicas"] = self.replicas()
+        if self._admission is not None:
+            out["tenancy"] = self._admission.state()
         return out
 
     def health_snapshot(self) -> dict:
@@ -715,13 +766,18 @@ class Router:
             deadline=now + remaining, future=Future(),
             trace_id=orphan.trace_id, slo_class=cls_name,
             tiled=getattr(orphan, "tiled", False),
+            tenant=getattr(orphan, "tenant", None),
+            # A replayed orphan is by definition a request an earlier
+            # incarnation may have executed: the dispatch path must
+            # probe + pin it like any client-marked retry.
+            retried=True,
         )
         rec.replayed = True
         # Re-accept under THIS incarnation's epoch so a second router
         # death replays it again (the scan dedupes by trace id).
         self._journal.accept(
             rec.trace_id, rec.x, remaining, slo_class=cls_name,
-            tiled=rec.tiled,
+            tiled=rec.tiled, tenant=rec.tenant,
         )
         self._m_replays.inc(outcome="redispatched")
         with self._lock:
@@ -779,6 +835,18 @@ class Router:
                             self._pending.appendleft(rec)
                             self._cond.wait(0.02)
                             continue
+                        pin = self._pin_for(rec)
+                        if pin is not None and pin != rep.name:
+                            # Exactly-once pin: every router dispatches
+                            # a RETRIED trace id to the same rendezvous
+                            # replica, whose served-cache/in-flight join
+                            # makes racing copies execute at most once
+                            # (tenancy/dedupe.py). This dispatcher is
+                            # not the pin — push back and let the pin
+                            # replica's dispatcher pull it.
+                            self._pending.appendleft(rec)
+                            self._cond.wait(0.02)
+                            continue
                         break
                     # Timed wait: health/backoff state changes outside
                     # the condition (scrape loop) must be re-checked.
@@ -789,7 +857,71 @@ class Router:
                 # would strand its record; fail it loudly instead.
                 self._fail(rec, rec.epoch, e)
 
+    def _pin_for(self, rec: _Record) -> "str | None":
+        """The rendezvous replica a RETRIED record must dispatch to —
+        computed over the currently-ACCEPTING membership so a dead pin
+        falls through to the same successor on every router. None for
+        normal records (no constraint) or when no replica accepts."""
+        if not rec.retried:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            names = [
+                r.name for r in self._replicas.values()
+                if r.accepting(now, self._depth_limit)
+            ]
+        order = pin_order(rec.trace_id, names)
+        return order[0] if order else None
+
+    def _probe_served(self, rec: _Record) -> bool:
+        """The fan-out `/served` probe a RETRIED record takes before ANY
+        dispatch: a voucher anywhere means an earlier attempt already
+        executed — complete from that replica's idempotency cache
+        instead of dispatching. Returns True when the record was
+        completed here (caller must not dispatch)."""
+        with self._lock:
+            reps = [r for r in self._replicas.values() if not r.removed]
+        for rep in reps:
+            try:
+                if rec.trace_id not in rep.client.served([rec.trace_id]):
+                    continue
+                remaining = max(0.5, rec.deadline - time.monotonic())
+                logits, payload = rep.client.predict(
+                    rec.x, rec.trace_id, deadline_s=remaining,
+                    timeout_s=remaining + 1.0, tiled=rec.tiled,
+                )
+            except Exception:  # noqa: BLE001 — a replica that cannot
+                continue  # vouch (or died holding the cache) proves
+                # nothing; the pinned dispatch path takes over
+            with rec.lock:
+                if rec.state == "done":
+                    return True
+                rec.state = "done"
+            self._journal_done(rec, "served")
+            end = time.monotonic()
+            with self._lock:
+                self._counts["served"] += 1
+                self._latencies.append(end - rec.submit_t)
+            self._m_requests.inc(outcome="served_cached")
+            if rec.replayed:
+                # The replay path's dedupe promise, kept by the probe:
+                # the orphan never re-executed.
+                self._m_replays.inc(outcome="deduped")
+            self._m_latency.observe(end - rec.submit_t,
+                                    exemplar=rec.trace_id)
+            rec.future.trace_id = rec.trace_id
+            if payload and payload.get("engine_e2e_s") is not None:
+                rec.future.e2e_latency_s = payload["engine_e2e_s"]
+            self._emit_request_span(rec, end, "served_cached")
+            rec.future.set_result(logits)
+            return True
+        return False
+
     def _dispatch_one(self, rep: _Replica, rec: _Record) -> None:
+        if rec.retried and not rec.probed:
+            rec.probed = True
+            if self._probe_served(rec):
+                return
         now = time.monotonic()
         with rec.lock:
             if rec.state == "done":
@@ -823,6 +955,7 @@ class Router:
             logits, payload = rep.client.predict(
                 rec.x, rec.trace_id, deadline_s=remaining, timeout_s=timeout,
                 slo_class=rec.rpc_slo_class, tiled=rec.tiled,
+                tenant=rec.tenant, retried=rec.retried,
             )
         except ReplicaQueueFull as e:
             outcome, error = "queue_full", e
@@ -1012,8 +1145,10 @@ class Router:
                 "attempts": len(rec.history), "replicas": rec.history,
                 "e2e_latency_s": end - rec.submit_t,
                 "slo_class": rec.slo_class,
+                "tenant": rec.tenant or "default",
                 "router": self.name,
                 "replayed": rec.replayed,
+                "retried": rec.retried,
             },
         ))
 

@@ -120,6 +120,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler", choices=("edf", "fifo"), default="edf",
                    help="engine batch former (edf = continuous "
                         "scheduler; fifo = windowed baseline)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="tenant quota/weight specs for the engine "
+                        "(NAME=RPS:BURST[:WEIGHT][@CLASSES], comma-"
+                        "separated; NAME=none = unlimited) — must match "
+                        "the router's tenants for tenant propagation")
     return p
 
 
@@ -238,6 +243,7 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
         DrainedError,
         QueueFullError,
     )
+    from mpi4dl_tpu.tenancy.model import QuotaExceededError
 
     cache = _ServedCache()
 
@@ -319,7 +325,25 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                         deadline_s=req.get("deadline_s"),
                         trace_id=tid,
                         slo_class=req.get("slo_class"),
+                        # Only tenanted traffic forwards the kwarg, so
+                        # plain engines (and test stubs) keep working.
+                        **(
+                            {"tenant": req["tenant"]}
+                            if req.get("tenant") is not None else {}
+                        ),
                     )
+                except QuotaExceededError as e:
+                    # Engine-edge quota shed: typed 429 carrying the
+                    # token bucket's refill time, distinguishable from
+                    # a physically-full queue by error kind.
+                    self._reply(429, {
+                        "ok": False, "error": "quota_exceeded",
+                        "retry_after_s": e.retry_after_s,
+                        "tenant": e.tenant,
+                        "slo_class": e.slo_class,
+                        "shed": True,
+                    })
+                    return
                 except QueueFullError as e:
                     self._reply(429, {
                         "ok": False, "error": "queue_full",
@@ -423,6 +447,7 @@ def main(argv=None) -> int:
         tail_min_interval_s=args.tail_min_interval,
         slo_classes=args.slo_classes,
         scheduler=args.scheduler,
+        tenants=args.tenants,
     )
     if mesh_shape is not None:
         # Sharded replica: this process claims a device SUBSET shaped

@@ -108,6 +108,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "NAME:WEIGHT[:DEADLINE] comma-separated (e.g. "
                         "'tight:1:10s,bulk:3:60s'); the report then "
                         "carries per-class latency under by_class")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant admission: NAME=RPS:BURST[:WEIGHT]"
+                        "[@CLASSES] comma-separated (e.g. "
+                        "'tight=200:50:4,bulk=50:200:1@bulk', "
+                        "'bulk=none' = unlimited); each tenant gets a "
+                        "token-bucket quota (over-quota floods shed with "
+                        "retry_after_s BEFORE taking queue slots) and a "
+                        "deficit-weighted-fair share of EDF batch fill; "
+                        "an implicit unlimited 'default' tenant is "
+                        "appended for unlabeled traffic")
+    p.add_argument("--tenant-mix", default=None, metavar="MIX",
+                   help="loadgen traffic mix over tenants, NAME:WEIGHT "
+                        "comma-separated (e.g. 'bulk:10,tight:1'); the "
+                        "report then carries per-tenant outcomes and "
+                        "latency under by_tenant")
     p.add_argument("--mode", choices=("closed", "open"), default="closed")
     p.add_argument("--requests", type=int, default=64,
                    help="closed loop: total requests")
@@ -310,6 +325,7 @@ def _synthetic_engine(args):
 def _liveness_kw(args) -> dict:
     return {
         "slo_classes": args.slo_classes,
+        "tenants": args.tenants,
         "scheduler": args.scheduler,
         "watchdog_factor": args.watchdog_factor or None,
         "watchdog_min_timeout_s": args.watchdog_min_timeout,
@@ -478,6 +494,10 @@ def main(argv=None) -> int:
                 from mpi4dl_tpu.serve.loadgen import ClassMix
 
                 retry_kw["class_mix"] = ClassMix.parse(args.class_mix)
+            if args.tenant_mix:
+                from mpi4dl_tpu.serve.loadgen import TenantMix
+
+                retry_kw["tenant_mix"] = TenantMix.parse(args.tenant_mix)
             if args.mode == "closed":
                 report["loadgen"] = run_closed_loop(
                     engine, args.requests, concurrency=args.concurrency,

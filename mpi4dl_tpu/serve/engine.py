@@ -105,6 +105,11 @@ from mpi4dl_tpu.serve.scheduler import (
     SchedulerFull,
     normalize_classes,
 )
+from mpi4dl_tpu.tenancy.model import (
+    QuotaExceededError,
+    TenantAdmission,
+    normalize_tenants,
+)
 
 
 class QueueFullError(RuntimeError):
@@ -150,6 +155,9 @@ class _Request:
     future: Future
     trace_id: str = ""
     slo_class: str = "default"
+    # The admitted tenant (tenancy subsystem) — "default" when tenancy
+    # is off, so every label/series below stays single-valued.
+    tenant: str = "default"
     # Span boundaries (time.monotonic), filled in as the request moves:
     # picked by the batch former / batch complete / staged+dispatched.
     form_t: float = 0.0
@@ -432,6 +440,7 @@ class ServingEngine:
         slo_classes=None,
         scheduler: str = "edf",
         shed_ratio: float = 0.5,
+        tenants=None,
         predictor=None,
     ):
         import jax.numpy as jnp
@@ -449,10 +458,28 @@ class ServingEngine:
         self._max_wait_s = float(max_wait_s)
         self._default_deadline_s = float(default_deadline_s)
         self._classes = normalize_classes(slo_classes)
-        self._class_objectives = [
-            o for o in (c.objective() for c in self._classes)
-            if o is not None
-        ]
+        # Tenancy (mpi4dl_tpu/tenancy): None = OFF (everything runs as
+        # the implicit "default" tenant — identical label values and
+        # behavior to the pre-tenancy engine). ON = token-bucket quota
+        # admission in submit(), deficit-weighted-round-robin fill in
+        # the scheduler, and a `tenant` label on every per-class series.
+        self._tenants = normalize_tenants(tenants)
+        # Per-class latency objectives, per tenant allowed on the class
+        # when tenancy is ON (windows match label sets exactly, so each
+        # (class, tenant) series needs its own fully-selected objective;
+        # burn protection is then scoped to the burning tenant alone).
+        _obj_tenants = (
+            [t for t in self._tenants] if self._tenants is not None
+            else [None]
+        )
+        self._class_objectives = []
+        for c in self._classes:
+            for t in _obj_tenants:
+                if t is not None and t.classes and c.name not in t.classes:
+                    continue
+                o = c.objective(tenant=t.name if t is not None else "default")
+                if o is not None:
+                    self._class_objectives.append(o)
         # The compile/stage/run backend: single-chip by default, or an
         # injected mesh-aware predictor (serve/sharded.py) — the batcher,
         # scheduler, and telemetry above never see the difference.
@@ -570,9 +597,20 @@ class ServingEngine:
             if len(self._classes) > 1 and self._class_objectives
             else None
         )
+        # Quota admission (tenancy ON): token buckets refilled at each
+        # tenant's configured rate, consulted in submit() BEFORE any
+        # queue slot is occupied — an over-quota flood is shed with a
+        # refill-derived retry hint instead of crowding other tenants
+        # out of the bounded queues. None when tenancy is off.
+        self._admission = (
+            TenantAdmission(self._tenants, registry=self.registry)
+            if self._tenants is not None
+            else None
+        )
         self._sched = ClassScheduler(
             self._classes, max_queue=max_queue, registry=self.registry,
             mode=scheduler, feedback=feedback, shed_ratio=shed_ratio,
+            tenants=self._tenants,
         )
         self._poll_s = 0.02
         self._stop_evt = threading.Event()
@@ -581,6 +619,7 @@ class ServingEngine:
         self._counts = {
             "submitted": 0,
             "rejected_queue_full": 0,
+            "rejected_quota": 0,
             "rejected_deadline": 0,
             "served": 0,
             "served_late": 0,
@@ -613,6 +652,12 @@ class ServingEngine:
         # queue-depth gauges (total + per-class) are owned by the
         # scheduler, which already declared them above.
         self._m_class_latency = decl("serve_class_latency_seconds")
+        # The tenancy series exist with or without configured tenants
+        # (the catalog pin: one engine exposes exactly the catalog);
+        # with tenancy off they simply never move off their zeros.
+        decl("tenant_quota_tokens")
+        decl("tenant_quota_sheds_total")
+        decl("tenant_admitted_total")
         self._m_spans = decl("serve_span_seconds")
         self._m_phase_share = decl("serve_phase_share")
         self._phase_totals: dict[str, float] = {}
@@ -871,6 +916,7 @@ class ServingEngine:
         deadline_s: float | None = None,
         trace_id: "str | None" = None,
         slo_class: "str | None" = None,
+        tenant: "str | None" = None,
     ) -> Future:
         """Enqueue one example — or a multi-image batch of shape
         ``(n, *example_shape)``, which is split into per-image requests
@@ -887,6 +933,16 @@ class ServingEngine:
         class. The class decides EDF queueing, the default deadline,
         and which per-class latency objective the request's outcome
         burns.
+
+        tenant: the submitting tenant (``tenants=`` at construction).
+        None lands in the ``default`` tenant. With tenancy configured,
+        the tenant's token bucket is debited per row BEFORE any queue
+        slot is taken — over quota raises
+        :class:`~mpi4dl_tpu.tenancy.QuotaExceededError` whose
+        ``retry_after_s`` is the bucket's refill time; an unknown
+        tenant or a class outside the tenant's allowlist raises
+        ``ValueError``. With tenancy off the name is carried through
+        to labels/spans but nothing is enforced.
 
         trace_id: distributed-trace propagation — a caller in ANOTHER
         process (load generator, fleet router) passes the id it minted so
@@ -910,6 +966,27 @@ class ServingEngine:
         cls = self._sched.resolve(slo_class)
         if self._stop_evt.is_set() and self._thread is None:
             raise RuntimeError("engine is stopped; call start() first")
+        # Quota admission BEFORE the deadline check or any queue work:
+        # an over-quota flood must be shed before it occupies anything.
+        # Raises QuotaExceededError (retry_after_s = the bucket's refill
+        # time for the debited rows) or ValueError for an unknown tenant
+        # / class-allowlist violation — both typed, both pre-queue.
+        n_rows = (
+            x.shape[0]
+            if x.ndim == len(self.example_shape) + 1 else 1
+        )
+        if self._admission is not None:
+            try:
+                ten = self._admission.admit(
+                    tenant, n=n_rows, slo_class=cls.name,
+                )
+            except QuotaExceededError:
+                with self._lock:
+                    self._counts["rejected_quota"] += n_rows
+                raise
+            tenant_name = ten.name
+        else:
+            tenant_name = tenant or "default"
         now = time.monotonic()
         if deadline_s is None:
             deadline_s = (
@@ -939,7 +1016,8 @@ class ServingEngine:
             _Request(
                 x=row, submit_t=now, deadline=ddl,
                 future=future if join is None else Future(),
-                trace_id=tid, slo_class=cls.name, join=join, row=i,
+                trace_id=tid, slo_class=cls.name, tenant=tenant_name,
+                join=join, row=i,
             )
             for i, row in enumerate(rows)
         ]
@@ -1016,6 +1094,8 @@ class ServingEngine:
         out["queue_depth"] = self._sched.qsize()
         out["queue_depth_by_class"] = self._sched.qsize_by_class()
         out["scheduler"] = self._sched.state()
+        if self._admission is not None:
+            out["tenancy"] = self._admission.state()
         out["pad_waste_ratio"] = padded / total if total else 0.0
         out["buckets"] = list(self._buckets)
         out["mesh"] = list(self.mesh_shape)
@@ -1372,7 +1452,7 @@ class ServingEngine:
             self._m_latency.observe(now - r.submit_t, exemplar=r.trace_id)
             self._m_class_latency.observe(
                 now - r.submit_t, exemplar=r.trace_id,
-                slo_class=r.slo_class,
+                slo_class=r.slo_class, tenant=r.tenant,
             )
             self._emit_spans(r, now, "served", bucket, len(reqs))
             if r.join is not None:
@@ -1415,7 +1495,7 @@ class ServingEngine:
             self.tail.observe(
                 r.trace_id, end_t - r.submit_t, spans,
                 outcome=outcome, bucket=bucket, batch_size=batch_size,
-                slo_class=r.slo_class,
+                slo_class=r.slo_class, tenant=r.tenant,
                 queue_depth_at_submit=r.queue_depth_at_submit,
                 dispatch_seq=r.dispatch_seq,
                 pad_waste_ratio=padded / total if total else 0.0,
@@ -1429,7 +1509,7 @@ class ServingEngine:
             attrs = {"outcome": outcome, "bucket": bucket,
                      "batch_size": batch_size,
                      "e2e_latency_s": end_t - r.submit_t,
-                     "slo_class": r.slo_class,
+                     "slo_class": r.slo_class, "tenant": r.tenant,
                      "pid": os.getpid(), "role": "engine"}
             if r.tiled is not None:
                 attrs["tiled"] = dict(r.tiled)

@@ -108,10 +108,12 @@ class SLOClass:
         """The ``slo=`` label value the evaluator publishes burn under."""
         return f"latency_{self.name}"
 
-    def objective(self):
+    def objective(self, tenant: str = "default"):
         """The class's latency :class:`~mpi4dl_tpu.telemetry.slo.
         Objective` over the per-class histogram; None when the class
-        declares no threshold."""
+        declares no threshold. ``tenant`` scopes the objective to one
+        tenant's series (a tenancy-enabled engine builds one objective
+        per (class, tenant), so each tenant burns its OWN budget)."""
         if self.latency_threshold_s is None:
             return None
         from mpi4dl_tpu.telemetry.slo import latency_objective
@@ -121,7 +123,8 @@ class SLOClass:
             self.latency_threshold_s,
             metric="serve_class_latency_seconds",
             name=self.slo_name,
-            labels=(("slo_class", self.name),),
+            labels=(("slo_class", self.name), ("tenant", tenant)),
+            tenant=tenant,
         )
 
 
@@ -259,57 +262,116 @@ class ClassFeedback:
         self._lock = threading.Lock()
         self._last_eval = float("-inf")
         self._states = {c.name: "normal" for c in self._classes}
+        self._tenant_states: "dict[tuple[str, str], str]" = {}
         self._burns: "dict[str, float | None]" = {
             c.name: None for c in self._classes
         }
 
-    def burns(self) -> "dict[str, float | None]":
-        """Per-class page-window burn, straight off the gauges; None for
-        a class with no published series (no objective, or the
-        evaluator hasn't ticked)."""
-        out: "dict[str, float | None]" = {c.name: None for c in self._classes}
+    def burns_by_tenant(self) -> "dict[str, dict[str, float]]":
+        """Per-class, per-tenant page-window burn, straight off the
+        gauges (``slo_burn_rate{slo=latency_<class>, tenant=}``); a
+        class/tenant pair with no published series is simply absent."""
+        out: "dict[str, dict[str, float]]" = {
+            c.name: {} for c in self._classes
+        }
         m = self._registry.get("slo_burn_rate") if self._registry else None
         if m is None:
             return out
-        by_slo = {
-            s["labels"].get("slo"): s["value"]
-            for s in m.snapshot_series()
-            if s["labels"].get("window") == FEEDBACK_BURN_WINDOW
-        }
+        by_slo: "dict[str, dict[str, float]]" = {}
+        for s in m.snapshot_series():
+            if s["labels"].get("window") != FEEDBACK_BURN_WINDOW:
+                continue
+            by_slo.setdefault(s["labels"].get("slo"), {})[
+                s["labels"].get("tenant", "default")
+            ] = float(s["value"])
         for c in self._classes:
             if c.slo_name in by_slo:
-                out[c.name] = float(by_slo[c.slo_name])
+                out[c.name] = dict(by_slo[c.slo_name])
         return out
 
-    def states(self, now: "float | None" = None) -> "dict[str, str]":
-        """Per-class ``"normal" | "deprioritized"``, recomputed at most
-        every ``min_interval_s``."""
-        now = self._clock() if now is None else now
-        with self._lock:
-            if now - self._last_eval < self.min_interval_s:
-                return dict(self._states)
-            self._last_eval = now
-        burns = self.burns()
-        danger = {
-            n for n, b in burns.items()
-            if b is not None and b > self.protect_factor
-        }
-        if danger:
-            floor = self.shed_floor * self.protect_factor
-            depri = {
-                n for n, b in burns.items()
-                if n not in danger and (b is None or b <= floor)
+    def burns(self) -> "dict[str, float | None]":
+        """Per-class page-window burn (the default tenant's series, or
+        the worst tenant when only per-tenant series exist); None for a
+        class with no published series."""
+        out: "dict[str, float | None]" = {}
+        bbt = self.burns_by_tenant()
+        for c in self._classes:
+            per = bbt[c.name]
+            if "default" in per:
+                out[c.name] = per["default"]
+            else:
+                out[c.name] = max(per.values()) if per else None
+        return out
+
+    def _recompute(self, now: float) -> None:
+        """One rate-limited evaluation: burn protection scoped PER
+        TENANT — tenant t's slow-burning classes are deprioritized only
+        while one of t's OWN classes is in danger, so a burning tenant
+        cannot demote anyone else's bulk traffic."""
+        bbt = self.burns_by_tenant()
+        tenants = {t for per in bbt.values() for t in per}
+        tenants.add("default")
+        floor = self.shed_floor * self.protect_factor
+        tstates: "dict[tuple[str, str], str]" = {}
+        for t in tenants:
+            burns_t = {c.name: bbt[c.name].get(t) for c in self._classes}
+            danger = {
+                n for n, b in burns_t.items()
+                if b is not None and b > self.protect_factor
             }
-        else:
             depri = set()
+            if danger:
+                depri = {
+                    n for n, b in burns_t.items()
+                    if n not in danger and (b is None or b <= floor)
+                }
+            for c in self._classes:
+                tstates[(c.name, t)] = (
+                    "deprioritized" if c.name in depri else "normal"
+                )
         states = {
-            c.name: "deprioritized" if c.name in depri else "normal"
+            c.name: tstates.get((c.name, "default"), "normal")
+            for c in self._classes
+        }
+        burns = {
+            c.name: bbt[c.name].get(
+                "default",
+                max(bbt[c.name].values()) if bbt[c.name] else None,
+            )
             for c in self._classes
         }
         with self._lock:
+            self._tenant_states = tstates
             self._states = states
             self._burns = burns
-        return dict(states)
+
+    def states(self, now: "float | None" = None) -> "dict[str, str]":
+        """Per-class ``"normal" | "deprioritized"`` for the default
+        tenant, recomputed at most every ``min_interval_s``."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            fresh = now - self._last_eval < self.min_interval_s
+            if not fresh:
+                self._last_eval = now
+        if not fresh:
+            self._recompute(now)
+        with self._lock:
+            return dict(self._states)
+
+    def tenant_states(
+        self, now: "float | None" = None
+    ) -> "dict[tuple[str, str], str]":
+        """Per-(class, tenant) states — the scheduler's and router's
+        tenancy-aware view; same rate limit as :meth:`states`."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            fresh = now - self._last_eval < self.min_interval_s
+            if not fresh:
+                self._last_eval = now
+        if not fresh:
+            self._recompute(now)
+        with self._lock:
+            return dict(self._tenant_states)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -319,6 +381,11 @@ class ClassFeedback:
                 "shed_floor": self.shed_floor,
                 "burns": dict(self._burns),
                 "states": dict(self._states),
+                "states_by_tenant": {
+                    f"{c}/{t}": st
+                    for (c, t), st in self._tenant_states.items()
+                    if st != "normal"
+                },
             }
 
 
@@ -346,6 +413,12 @@ class ClassScheduler:
         and shedding (single-class engines).
     shed_ratio: fraction of the class queue bound at which a
         DEPRIORITIZED class starts shedding admissions.
+    tenants: normalized :class:`~mpi4dl_tpu.tenancy.Tenant` tuple (or a
+        spec string / None). When set, each class's queue is
+        sub-partitioned per tenant and batch slots are filled across
+        tenants by deficit-weighted round robin — in-quota traffic from
+        one tenant cannot monopolize batch formation. None = tenancy
+        off (single implicit ``default`` tenant, DWRR skipped).
     """
 
     def __init__(
@@ -356,6 +429,7 @@ class ClassScheduler:
         mode: str = "edf",
         feedback: "ClassFeedback | None" = None,
         shed_ratio: float = 0.5,
+        tenants=None,
         clock=time.monotonic,
     ):
         if mode not in ("edf", "fifo"):
@@ -373,7 +447,23 @@ class ClassScheduler:
         self.shed_ratio = float(shed_ratio)
         self._clock = clock
         self._cond = threading.Condition()
-        self._heaps: "dict[str, list]" = {c.name: [] for c in self.classes}
+        # class -> tenant -> heap; tenant sub-heaps appear on first use
+        # (an engine without tenancy only ever grows the default one).
+        self._heaps: "dict[str, dict[str, list]]" = {
+            c.name: {} for c in self.classes
+        }
+        self._dwrr = None
+        from mpi4dl_tpu.tenancy.model import (
+            DeficitRoundRobin,
+            normalize_tenants,
+        )
+
+        self.tenants = normalize_tenants(tenants)
+        if self.tenants is not None and mode == "edf":
+            weights = {t.name: t.weight for t in self.tenants}
+            self._dwrr = {
+                c.name: DeficitRoundRobin(weights) for c in self.classes
+            }
         self._seq = 0
         self.shed_counts = {c.name: 0 for c in self.classes}
         self._m_depth = self._m_class_depth = None
@@ -414,10 +504,12 @@ class ClassScheduler:
 
     # -- admission -------------------------------------------------------------
 
-    def _states(self) -> "dict[str, str]":
+    def _states(self) -> "dict[tuple[str, str], str]":
+        """Per-(class, tenant) feedback states (empty dict = feedback
+        off); the internal key shape every admission/pop site uses."""
         if self.feedback is None or self.mode == "fifo":
             return {}
-        return self.feedback.states(self._clock())
+        return self.feedback.tenant_states(self._clock())
 
     def put_many(self, reqs: "list") -> int:
         """Admit a group of same-class requests atomically: all enqueue
@@ -428,11 +520,13 @@ class ClassScheduler:
         if not reqs:
             return 0
         name = reqs[0].slo_class
+        tenant = getattr(reqs[0], "tenant", "default") or "default"
         states = self._states()
         with self._cond:
-            heap = self._heaps[name]
-            depth = len(heap)
-            if states.get(name) == "deprioritized":
+            tmap = self._heaps[name]
+            heap = tmap.setdefault(tenant, [])
+            depth = sum(len(h) for h in tmap.values())
+            if states.get((name, tenant)) == "deprioritized":
                 shed_at = max(1, int(self.shed_ratio * self.capacity))
                 if depth + len(reqs) > shed_at:
                     self.shed_counts[name] += len(reqs)
@@ -447,7 +541,7 @@ class ClassScheduler:
                 self._seq += 1
                 pri = r.deadline if self.mode == "edf" else float(self._seq)
                 heapq.heappush(heap, (pri, self._seq, r))
-            depth = len(heap)
+            depth = sum(len(h) for h in tmap.values())
             self._cond.notify()
         self._publish_depths(states)
         return depth
@@ -457,31 +551,54 @@ class ClassScheduler:
 
     # -- the batch former ------------------------------------------------------
 
-    def _pop_best(self, now: float, states: "dict[str, str]",
+    def _pop_best(self, now: float, states: "dict[tuple[str, str], str]",
                   expired: "list") -> "object | None":
         """Pop the globally best request under the mode's ordering:
-        fifo → lowest sequence; edf → protected classes first, then
-        earliest deadline (sequence breaks ties). Requests whose
-        deadline already passed are stamped and moved to ``expired``
-        (they never occupy a batch slot). Caller holds the lock."""
+        fifo → lowest sequence; edf → protected (class, tenant) queues
+        first, then earliest deadline (sequence breaks ties). With
+        tenancy configured, the EDF/depri key still chooses WHICH CLASS
+        the slot goes to, but WHICH TENANT fills it is the class's
+        deficit-weighted round robin — so a tenant flooding in-quota
+        traffic still cannot take more than its weighted share of batch
+        slots. Requests whose deadline already passed are stamped and
+        moved to ``expired`` (they never occupy a batch slot). Caller
+        holds the lock."""
         while True:
-            best_name, best_key = None, None
-            for name, heap in self._heaps.items():
-                if not heap:
-                    continue
-                pri, seq, _ = heap[0]
-                if self.mode == "fifo":
-                    key = (seq,)
-                else:
-                    key = (
-                        1 if states.get(name) == "deprioritized" else 0,
-                        pri, seq,
-                    )
-                if best_key is None or key < best_key:
-                    best_name, best_key = name, key
-            if best_name is None:
+            best = None  # (key, class, tenant)
+            for name, tmap in self._heaps.items():
+                for tenant, heap in tmap.items():
+                    if not heap:
+                        continue
+                    pri, seq, _ = heap[0]
+                    if self.mode == "fifo":
+                        key = (seq,)
+                    else:
+                        key = (
+                            1 if states.get((name, tenant))
+                            == "deprioritized" else 0,
+                            pri, seq,
+                        )
+                    if best is None or key < best[0]:
+                        best = (key, name, tenant)
+            if best is None:
                 return None
-            _, _, req = heapq.heappop(self._heaps[best_name])
+            key, name, tenant = best
+            if self._dwrr is not None:
+                # Fair fill across tenants at the SAME depri level —
+                # DWRR must never promote a deprioritized tenant's
+                # queue over a protected one.
+                level = key[0] if self.mode == "edf" else 0
+                active = [
+                    t for t, h in self._heaps[name].items()
+                    if h and (
+                        1 if states.get((name, t)) == "deprioritized"
+                        else 0
+                    ) == level
+                ]
+                pick = self._dwrr[name].pick(active)
+                if pick is not None:
+                    tenant = pick
+            _, _, req = heapq.heappop(self._heaps[name][tenant])
             req.form_t = now
             if now > req.deadline:
                 expired.append(req)
@@ -510,7 +627,9 @@ class ClassScheduler:
         states = self._states()
         with self._cond:
             deadline = self._clock() + first_timeout_s
-            while not any(self._heaps.values()):
+            while not any(
+                h for tmap in self._heaps.values() for h in tmap.values()
+            ):
                 remaining = deadline - self._clock()
                 if remaining <= 0:
                     return [], []
@@ -535,24 +654,41 @@ class ClassScheduler:
         particular order."""
         out = []
         with self._cond:
-            for heap in self._heaps.values():
-                out.extend(req for _, _, req in heap)
-                heap.clear()
+            for tmap in self._heaps.values():
+                for heap in tmap.values():
+                    out.extend(req for _, _, req in heap)
+                    heap.clear()
         self._publish_depths({})
         return out
 
     def qsize(self) -> int:
         with self._cond:
-            return sum(len(h) for h in self._heaps.values())
+            return sum(
+                len(h) for tmap in self._heaps.values()
+                for h in tmap.values()
+            )
 
     def qsize_by_class(self) -> "dict[str, int]":
         with self._cond:
-            return {name: len(h) for name, h in self._heaps.items()}
+            return {
+                name: sum(len(h) for h in tmap.values())
+                for name, tmap in self._heaps.items()
+            }
+
+    def qsize_by_tenant(self) -> "dict[str, dict[str, int]]":
+        """class → tenant → depth (the tenancy debug view)."""
+        with self._cond:
+            return {
+                name: {t: len(h) for t, h in tmap.items() if h}
+                for name, tmap in self._heaps.items()
+            }
 
     def empty(self) -> bool:
         return self.qsize() == 0
 
-    def _publish_depths(self, states: "dict[str, str]") -> None:
+    def _publish_depths(
+        self, states: "dict[tuple[str, str], str]"
+    ) -> None:
         if self._m_depth is None:
             return
         depths = self.qsize_by_class()
@@ -561,15 +697,16 @@ class ClassScheduler:
             self._m_class_depth.set(d, slo_class=name)
         if states:
             for name in self._heaps:
-                self._m_depri.set(
-                    1.0 if states.get(name) == "deprioritized" else 0.0,
-                    slo_class=name,
+                depri = any(
+                    st == "deprioritized"
+                    for (c, _t), st in states.items() if c == name
                 )
+                self._m_depri.set(1.0 if depri else 0.0, slo_class=name)
 
     def state(self) -> dict:
         """The stats()/debugz payload: per-class depths, shed counts,
-        the live feedback view."""
-        return {
+        the live feedback + tenancy view."""
+        out = {
             "mode": self.mode,
             "capacity_per_class": self.capacity,
             "depth_by_class": self.qsize_by_class(),
@@ -579,3 +716,10 @@ class ClassScheduler:
                 else None
             ),
         }
+        if self.tenants is not None:
+            out["depth_by_tenant"] = self.qsize_by_tenant()
+            if self._dwrr is not None:
+                out["dwrr"] = {
+                    name: rr.state() for name, rr in self._dwrr.items()
+                }
+        return out

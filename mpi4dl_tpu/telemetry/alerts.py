@@ -158,6 +158,16 @@ class AlertState:
         }
 
 
+def _alert_name(obj, bw) -> str:
+    """Alert key for one (objective, burn window). Per-tenant objectives
+    share their ``obj.name`` across tenants (the per-class SLO name),
+    so the tenant joins the key — otherwise two tenants' burn alerts
+    would collapse into one state machine and mask each other."""
+    if getattr(obj, "tenant", "default") in ("", "default"):
+        return f"{obj.name}_{bw.name}_burn"
+    return f"{obj.name}_{obj.tenant}_{bw.name}_burn"
+
+
 class SLOEvaluator:
     """Continuous SLO evaluation over the live registry.
 
@@ -207,7 +217,7 @@ class SLOEvaluator:
         self.alerts: "dict[str, AlertState]" = {}
         for obj in self.objectives:
             for bw in config.burn_windows:
-                name = f"{obj.name}_{bw.name}_burn"
+                name = _alert_name(obj, bw)
                 self.alerts[name] = AlertState(
                     name, bw.severity, for_s=config.for_s
                 )
@@ -269,18 +279,20 @@ class SLOEvaluator:
         for obj in self.objectives:
             rem = slo_mod.budget_remaining(self.registry, obj)
             if rem is not None:
-                self._m_budget.set(rem, slo=obj.name)
+                self._m_budget.set(rem, slo=obj.name, tenant=obj.tenant)
             for bw in self.config.burn_windows:
                 b_long = slo_mod.burn_rate(self.window, obj, bw.long_s)
                 b_short = slo_mod.burn_rate(self.window, obj, bw.short_s)
-                burns[(obj.name, bw.name)] = (b_long, b_short)
+                burns[(obj.name, obj.tenant, bw.name)] = (b_long, b_short)
                 if b_long is not None:
                     self._m_burn.set(
-                        b_long, slo=obj.name, window=f"{bw.name}_long"
+                        b_long, slo=obj.name, window=f"{bw.name}_long",
+                        tenant=obj.tenant,
                     )
                 if b_short is not None:
                     self._m_burn.set(
-                        b_short, slo=obj.name, window=f"{bw.name}_short"
+                        b_short, slo=obj.name, window=f"{bw.name}_short",
+                        tenant=obj.tenant,
                     )
                 if bw.severity == "page" and b_long is not None:
                     page_burn = (
@@ -290,7 +302,7 @@ class SLOEvaluator:
                     b_long is not None and b_short is not None
                     and b_long > bw.factor and b_short > bw.factor
                 )
-                name = f"{obj.name}_{bw.name}_burn"
+                name = _alert_name(obj, bw)
                 st = self.alerts[name]
                 moved = st.step(active, now)
                 self._m_active.set(
@@ -361,6 +373,7 @@ class SLOEvaluator:
                 "from": old,
                 "to": new,
                 "slo": obj.name,
+                "tenant": obj.tenant,
                 "objective": obj.target,
                 "factor": bw.factor,
                 "burn_long": b_long,
@@ -411,8 +424,10 @@ class SLOEvaluator:
             burns = dict(self._last_burns)
         slos = []
         for obj in self.objectives:
+            key = (obj.name, obj.tenant)
             entry = {
                 "slo": obj.name,
+                "tenant": obj.tenant,
                 "kind": obj.kind,
                 "objective": obj.target,
                 "metric": obj.metric,
@@ -422,8 +437,8 @@ class SLOEvaluator:
                 ),
                 "burn": {
                     bw.name: {
-                        "long": burns.get((obj.name, bw.name), (None, None))[0],
-                        "short": burns.get((obj.name, bw.name), (None, None))[1],
+                        "long": burns.get((*key, bw.name), (None, None))[0],
+                        "short": burns.get((*key, bw.name), (None, None))[1],
                         "factor": bw.factor,
                         "long_s": bw.long_s,
                         "short_s": bw.short_s,
@@ -455,12 +470,18 @@ class SLOEvaluator:
         page alert ever fired and every budget ends non-negative."""
         out = {"ok": True, "slos": {}, "alerts_fired": {}}
         for obj in self.objectives:
-            rem = slo_mod.budget_remaining(self.registry, obj)
-            out["slos"][obj.name] = {
+            key = (
+                obj.name if obj.tenant == "default"
+                else f"{obj.name}:{obj.tenant}"
+            )
+            out["slos"][key] = {
                 "objective": obj.target,
                 "sli": slo_mod.cumulative_sli(self.registry, obj),
-                "budget_remaining": rem,
+                "budget_remaining": slo_mod.budget_remaining(
+                    self.registry, obj
+                ),
             }
+            rem = out["slos"][key]["budget_remaining"]
             if rem is not None and rem < 0:
                 out["ok"] = False
         for a in self.alerts.values():

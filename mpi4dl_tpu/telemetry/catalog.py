@@ -43,8 +43,10 @@ CATALOG: "dict[str, MetricSpec]" = {
     "serve_requests_total": MetricSpec(
         "counter", ("outcome",),
         "Terminal request outcomes: served, served_late, "
-        "rejected_queue_full, rejected_deadline, drained (flushed by a "
-        "deliberate stop/drain — excluded from the availability SLO).",
+        "rejected_queue_full, rejected_quota (tenant token bucket "
+        "empty — shed before any queue slot), rejected_deadline, "
+        "drained (flushed by a deliberate stop/drain — excluded from "
+        "the availability SLO).",
     ),
     "serve_queue_depth": MetricSpec(
         "gauge", (),
@@ -71,10 +73,11 @@ CATALOG: "dict[str, MetricSpec]" = {
         "End-to-end latency of served requests (submit -> result ready).",
     ),
     "serve_class_latency_seconds": MetricSpec(
-        "histogram", ("slo_class",),
-        "End-to-end latency of served requests, by SLO class — the "
-        "per-class latency objectives (slo_burn_rate{slo=latency_<class>}"
-        ") the EDF scheduler's burn-rate feedback reads back.",
+        "histogram", ("slo_class", "tenant"),
+        "End-to-end latency of served requests, by SLO class and tenant "
+        "— the per-class latency objectives (slo_burn_rate{slo="
+        "latency_<class>}) the EDF scheduler's burn-rate feedback reads "
+        "back, scoped per tenant (tenant=default when tenancy is off).",
     ),
     "serve_class_queue_depth": MetricSpec(
         "gauge", ("slo_class",),
@@ -227,14 +230,16 @@ CATALOG: "dict[str, MetricSpec]" = {
     ),
     # -- SLO engine (mpi4dl_tpu/telemetry/slo.py, alerts.py, autoscale.py) ---
     "slo_error_budget_remaining": MetricSpec(
-        "gauge", ("slo",),
+        "gauge", ("slo", "tenant"),
         "Fraction of the error budget left over the process lifetime: "
-        "1 = untouched, 0 = exactly spent, negative = objective violated.",
+        "1 = untouched, 0 = exactly spent, negative = objective violated. "
+        "Per tenant for per-class objectives (tenant=default otherwise).",
     ),
     "slo_burn_rate": MetricSpec(
-        "gauge", ("slo", "window"),
-        "Error-budget burn rate per objective and burn window "
-        "(fast_long/fast_short/slow_long/slow_short); 1.0 spends exactly "
+        "gauge", ("slo", "window", "tenant"),
+        "Error-budget burn rate per objective, burn window "
+        "(fast_long/fast_short/slow_long/slow_short), and tenant "
+        "(tenant=default for untenanted objectives); 1.0 spends exactly "
         "the budget over the SLO period.",
     ),
     "alert_active": MetricSpec(
@@ -254,8 +259,9 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Router-terminal request outcomes: served, served_cached (a "
         "failover retry answered from a replica's idempotency cache — "
         "never re-executed), failed (retry budget spent), "
-        "rejected_queue_full (router admission), rejected_deadline, "
-        "drained (router stopped).",
+        "rejected_queue_full (router admission), rejected_quota (tenant "
+        "token bucket empty at the front door — shed before any queue "
+        "slot), rejected_deadline, drained (router stopped).",
     ),
     "fleet_requeues_total": MetricSpec(
         "counter", ("reason",),
@@ -383,6 +389,22 @@ CATALOG: "dict[str, MetricSpec]" = {
         "gauge", ("program",),
         "Images/sec through the pipeline schedule during the latest "
         "capture (global batch images per mean captured step wall).",
+    ),
+    # -- tenancy (mpi4dl_tpu/tenancy/model.py TenantAdmission) ---------------
+    "tenant_quota_tokens": MetricSpec(
+        "gauge", ("tenant",),
+        "Current token-bucket level per tenant at this admission edge "
+        "(burst = full); refreshed on every admission decision.",
+    ),
+    "tenant_quota_sheds_total": MetricSpec(
+        "counter", ("tenant",),
+        "Admissions shed because the tenant's token bucket was empty — "
+        "the QuotaExceededError count, charged before any queue slot.",
+    ),
+    "tenant_admitted_total": MetricSpec(
+        "counter", ("tenant",),
+        "Requests admitted past the tenant quota gate at this edge "
+        "(tenant=default covers untenanted traffic).",
     ),
     # -- load generator (mpi4dl_tpu/serve/loadgen.py) ------------------------
     "loadgen_requests_total": MetricSpec(

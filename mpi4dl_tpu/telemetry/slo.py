@@ -82,6 +82,11 @@ class Objective:
     # this. Empty = the metric's unlabeled series (the classic e2e
     # objective).
     labels: tuple = ()
+    # The tenant this objective is scoped to: the evaluator publishes
+    # slo_burn_rate / slo_error_budget_remaining under tenant=<this>,
+    # and per-(class, tenant) objectives carry it in their label
+    # selector. "default" = untenanted (the pre-tenancy behavior).
+    tenant: str = "default"
 
     def __post_init__(self):
         if not 0.0 < self.target < 1.0:
@@ -116,12 +121,14 @@ def latency_objective(
     metric: str = "serve_request_latency_seconds",
     name: str = "latency",
     labels: tuple = (),
+    tenant: str = "default",
 ) -> Objective:
     if threshold_s <= 0:
         raise ValueError(f"latency threshold must be > 0, got {threshold_s}")
     return Objective(
         name=name, kind="latency", target=target, metric=metric,
         threshold_s=float(threshold_s), labels=tuple(labels),
+        tenant=tenant,
     )
 
 

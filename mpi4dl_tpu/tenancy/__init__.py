@@ -1,0 +1,36 @@
+"""Multi-tenant QoS: quotas, weighted-fair admission, fleet-wide dedupe.
+
+The serving stack partitions *latency* by SLO class (PR 11) and
+survives *deaths* (PR 12), but until this subsystem nothing partitioned
+*capacity*: one tenant's flood could fill every batch slot and burn
+every other tenant's error budget. ``mpi4dl_tpu.tenancy`` is that
+missing layer, enforced at both admission edges:
+
+- :class:`Tenant` / :func:`parse_tenants` — the tenant model
+  (``NAME=RPS:BURST[:WEIGHT][@CLASSES]``), parsed exactly like the SLO
+  class spec it composes with.
+- :class:`TokenBucket` / :class:`TenantAdmission` — per-tenant
+  token-bucket quotas applied by the fleet router AND the engine; an
+  over-quota flood is shed with a typed :class:`QuotaExceededError`
+  whose ``retry_after_s`` is the bucket's own refill time, BEFORE the
+  flood occupies a queue slot.
+- :class:`DeficitRoundRobin` — the deficit-weighted-round-robin fill
+  the per-class EDF heaps use across tenants, so batch formation cannot
+  be monopolized even by in-quota traffic.
+- :mod:`mpi4dl_tpu.tenancy.dedupe` — rendezvous pinning + served-cache
+  fan-out, closing the docs/FLEET.md double-execute residual for
+  ``retried:true`` requests racing a router death.
+"""
+
+from mpi4dl_tpu.tenancy.model import (  # noqa: F401
+    DEFAULT_TENANT,
+    DeficitRoundRobin,
+    QuotaExceededError,
+    Tenant,
+    TenantAdmission,
+    TokenBucket,
+    default_tenants,
+    normalize_tenants,
+    parse_tenants,
+)
+from mpi4dl_tpu.tenancy.dedupe import pin_order, pin_replica  # noqa: F401

@@ -733,3 +733,58 @@ def test_costmodel_series_trended_with_correct_signs(tmp_path):
         _round(2, 0, with_costmodel(0.85, 2.8e-5, 0.05)),
     ])
     assert main(paths) == 0
+
+
+def test_multitenant_series_trended_with_correct_signs(tmp_path):
+    """ISSUE 17 satellite: the multitenant extra trends the victim's
+    flood/solo p99 ratio with the INVERTED sign (a grown ratio means
+    tenant isolation regressed) and Jain's fairness index with the
+    NORMAL sign (falling fairness regresses); the tenancy-on rps rides
+    the generic ``value`` path."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def multitenant(rps, ratio, jain):
+        r = _result(7.0, 0.5)
+        r["extras"]["multitenant"] = {
+            "value": rps, "overhead_pct": 0.8,
+            "victim_p99_ratio": ratio, "fairness_index": jain,
+            "served_by_tenant": {"bully": 200, "victim": 20},
+        }
+        return r
+
+    s = extract_series(multitenant(300.0, 1.12, 0.97))
+    assert s["multitenant"] == 300.0
+    assert s["multitenant.victim_p99_ratio"] == 1.12
+    assert s["multitenant.fairness_index"] == 0.97
+    assert lower_is_better("multitenant.victim_p99_ratio")
+    assert not lower_is_better("multitenant.fairness_index")
+    assert not lower_is_better("multitenant")
+    # A grown victim ratio regresses (isolation lost under the flood)...
+    good, worse = multitenant(300.0, 1.1, 0.97), multitenant(300.0, 1.4, 0.97)
+    paths = _write_rounds(tmp_path, [_round(1, 0, good),
+                                     _round(2, 0, worse)])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [good, worse]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["multitenant.victim_p99_ratio"]["verdict"] == "regressed"
+    # ...and so does falling fairness at a held ratio.
+    unfair = multitenant(300.0, 1.1, 0.72)
+    paths = _write_rounds(tmp_path, [_round(1, 0, good),
+                                     _round(2, 0, unfair)])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [good, unfair]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["multitenant.fairness_index"]["verdict"] == "regressed"
+    # An improving (shrinking) ratio exits clean.
+    better = multitenant(300.0, 1.02, 0.99)
+    paths = _write_rounds(tmp_path, [_round(1, 0, good),
+                                     _round(2, 0, better)])
+    assert main(paths) == 0

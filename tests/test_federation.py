@@ -175,7 +175,7 @@ def test_fleet_slo_and_autoscaler_over_live_replicas():
         )
         agg.scrape_once(now=30.0)
         burn = agg.registry.get("slo_burn_rate").value(
-            slo="availability", window="fast_long"
+            slo="availability", window="fast_long", tenant="default"
         )
         assert burn is not None and burn > 14.4  # fleet-wide page burn
         fired = agg.registry.get("alert_active").value(

@@ -80,6 +80,24 @@ def test_chaos_spec_router_goldens():
             parse_chaos_spec(bad)
 
 
+def test_chaos_spec_flood_goldens():
+    """ISSUE 17: the noisy-neighbor drill joins the grammar —
+    ``flood:TENANT=RPS[@AT]`` offers a tenant-labeled traffic burst."""
+    op = parse_chaos_spec("flood:bulk=500@2")
+    assert (op.action, op.domain, op.tenant, op.rps, op.at_s) == (
+        "flood", "tenant", "bulk", 500.0, 2.0
+    )
+    assert op.describe() == "flood:bulk=500rps@+2s"
+    # A flood needs both halves: who to flood as, and how hard.
+    with pytest.raises(ValueError, match="flood"):
+        parse_chaos_spec("flood:bulk")
+    with pytest.raises(ValueError, match="flood"):
+        parse_chaos_spec("flood=500")
+    # Tenant-name targets belong to flood alone.
+    with pytest.raises(ValueError, match="replica index"):
+        parse_chaos_spec("delay:bulk=3")
+
+
 # -- router recovery journal (ISSUE 12 tentpole) ------------------------------
 
 
@@ -338,9 +356,13 @@ class _FakeReplica:
     """A predict/healthz endpoint with scriptable behavior — the router
     sees a real HTTP surface without paying an engine compile."""
 
-    def __init__(self, mode="ok"):
+    def __init__(self, mode="ok", idempotent=False):
         self.mode = mode
+        self.idempotent = idempotent  # real replicas' _ServedCache shape:
+        # a repeated trace id returns the cached result, no re-execution
         self.served_trace_ids: "list[str]" = []
+        self.executions: "dict[str, int]" = {}
+        self.cache_hits = 0
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -377,7 +399,12 @@ class _FakeReplica:
                 if fake.mode == "error":
                     self._reply(500, {"ok": False, "error": "boom"})
                     return
-                fake.served_trace_ids.append(req["trace_id"])
+                tid = req["trace_id"]
+                if fake.idempotent and tid in fake.served_trace_ids:
+                    fake.cache_hits += 1
+                else:
+                    fake.executions[tid] = fake.executions.get(tid, 0) + 1
+                    fake.served_trace_ids.append(tid)
                 x = np.zeros(4, np.float32)
                 import base64
 
@@ -494,6 +521,121 @@ def test_router_failed_after_max_attempts_is_typed():
     finally:
         router.stop(drain=False)
         bad.close()
+
+
+def test_router_retried_probe_completes_from_cache():
+    """ISSUE 17 exactly-once: a ``retried:true`` submit probes /served
+    across the fleet BEFORE any dispatch; a voucher means the request
+    completes from the replica's idempotency cache — the model never
+    runs twice for one trace id."""
+    fakes = [_FakeReplica(idempotent=True), _FakeReplica(idempotent=True)]
+    router = _mk_router()
+    try:
+        for i, f in enumerate(fakes):
+            router.add_replica(f"r{i}", f.url, health_url=f.url)
+        x = np.zeros((2, 2, 3), np.float32)
+        out = router.submit(x, trace_id="t-x").result(timeout=10)
+        assert out.shape == (4,)
+        # The client retries after losing the response: same trace id,
+        # retried=True. The probe must find the voucher and short-circuit.
+        out2 = router.submit(x, trace_id="t-x", retried=True).result(
+            timeout=10
+        )
+        assert out2.shape == (4,)
+        assert sum(f.executions.get("t-x", 0) for f in fakes) == 1
+        assert router.registry.get("fleet_requests_total").value(
+            outcome="served_cached"
+        ) == 1
+    finally:
+        router.stop(drain=False)
+        for f in fakes:
+            f.close()
+
+
+def test_router_death_races_parked_retry_exactly_once(tmp_path):
+    """THE ISSUE 17 drill: a router dies holding an accepted-but-
+    undispatched request in its journal; its successor replays the
+    orphan and parks it, while the client's retry races in through a
+    SURVIVOR router. Exactly one execution by trace id, and the
+    successor's park must resolve as deduped — never a second serve."""
+    from mpi4dl_tpu.fleet.journal import RouterJournal, scan
+
+    path = tmp_path / "router.journal"
+    x = np.zeros((2, 2, 3), np.float32)
+    # The predecessor accepted t-race, journaled it, and died before
+    # dispatch: the journal is all that remains.
+    j = RouterJournal(str(path))
+    j.accept("t-race", x, 60.0)
+    j.close()
+
+    fake = _FakeReplica(idempotent=True)
+    successor = _mk_router(journal_path=str(path), replay_grace_s=2.0)
+    survivor = _mk_router()
+    try:
+        successor.add_replica("r0", fake.url, health_url=fake.url)
+        survivor.add_replica("r0", fake.url, health_url=fake.url)
+        # Successor replays: the orphan parks, polling /served for the
+        # grace window before it would re-dispatch.
+        assert successor.replay_journal() == 1
+        # The client retry lands on the survivor while the park is live.
+        out = survivor.submit(x, trace_id="t-race", retried=True).result(
+            timeout=10
+        )
+        assert out.shape == (4,)
+        # The successor's poll must observe the voucher and dedupe.
+        m = successor.registry.get("fleet_router_journal_replays_total")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if m.value(outcome="deduped") >= 1:
+                break
+            time.sleep(0.02)
+        assert m.value(outcome="deduped") == 1
+        # Zero double-executes by trace id — the drill's whole point.
+        assert fake.executions.get("t-race", 0) == 1
+        successor.stop(drain=True)
+        rec = scan(str(path))
+        assert not rec.orphans  # the journal closed the loop
+    finally:
+        for r in (successor, survivor):
+            try:
+                r.stop(drain=False)
+            except Exception:
+                pass
+        fake.close()
+
+
+def test_router_quota_shed_at_front_door():
+    """ISSUE 17 front-door quotas: over-quota submits shed with the
+    typed QuotaExceededError (+ refill-derived retry hint) BEFORE
+    taking a queue slot, and the shed is visible in router stats and
+    the tenant metrics."""
+    from mpi4dl_tpu.tenancy import QuotaExceededError
+
+    fake = _FakeReplica()
+    router = _mk_router(tenants="capped=1:1")
+    try:
+        router.add_replica("r0", fake.url, health_url=fake.url)
+        x = np.zeros((2, 2, 3), np.float32)
+        out = router.submit(x, tenant="capped").result(timeout=10)
+        assert out.shape == (4,)
+        with pytest.raises(QuotaExceededError) as ei:
+            router.submit(x, tenant="capped")
+        assert ei.value.tenant == "capped"
+        assert ei.value.retry_after_s == pytest.approx(1.0, rel=0.3)
+        s = router.stats()
+        assert s["rejected_quota"] == 1
+        assert s["tenancy"]["capped"]["rate_rps"] == 1.0
+        assert router.registry.get("fleet_requests_total").value(
+            outcome="rejected_quota"
+        ) == 1
+        assert router.registry.get("tenant_quota_sheds_total").value(
+            tenant="capped"
+        ) == 1
+        with pytest.raises(ValueError, match="unknown tenant"):
+            router.submit(x, tenant="nobody")
+    finally:
+        router.stop(drain=False)
+        fake.close()
 
 
 def test_router_replica_queue_full_requeues_without_burning_attempts():

@@ -100,8 +100,12 @@ def test_parse_slo_classes_goldens():
     # label value is what the scheduler's feedback reads back.
     obj = tight.objective()
     assert obj.metric == "serve_class_latency_seconds"
-    assert obj.labels == (("slo_class", "tight"),)
+    assert obj.labels == (("slo_class", "tight"), ("tenant", "default"))
     assert obj.name == "latency_tight"
+    # Tenant-scoped objective: same class, a per-tenant series + burn.
+    obj_b = tight.objective(tenant="bulk")
+    assert obj_b.labels == (("slo_class", "tight"), ("tenant", "bulk"))
+    assert obj_b.tenant == "bulk"
     with pytest.raises(ValueError, match="NAME=THRESHOLD"):
         parse_slo_classes("tight")
     with pytest.raises(ValueError, match="duplicate"):
@@ -213,16 +217,20 @@ def test_feedback_deprioritizes_and_sheds_slowest_burning_class():
     assert fb.states() == {"tight": "normal", "bulk": "normal"}
     # Tight burns hot, bulk burns cold -> bulk (the slowest burner)
     # yields; the protected class never does.
-    burn.set(20.0, slo="latency_tight", window="fast_long")
-    burn.set(0.1, slo="latency_bulk", window="fast_long")
+    burn.set(20.0, slo="latency_tight", window="fast_long",
+             tenant="default")
+    burn.set(0.1, slo="latency_bulk", window="fast_long",
+             tenant="default")
     assert fb.states() == {"tight": "normal", "bulk": "deprioritized"}
     # Both burning hot: nobody yields (can't rob Peter to pay Paul).
-    burn.set(20.0, slo="latency_bulk", window="fast_long")
+    burn.set(20.0, slo="latency_bulk", window="fast_long",
+             tenant="default")
     assert fb.states() == {"tight": "normal", "bulk": "normal"}
 
     # Scheduler honors the state: a deprioritized class goes LAST even
     # with the earliest deadline, and sheds early at shed_ratio.
-    burn.set(0.1, slo="latency_bulk", window="fast_long")
+    burn.set(0.1, slo="latency_bulk", window="fast_long",
+             tenant="default")
     s = ClassScheduler(
         classes, max_queue=8, registry=reg, mode="edf",
         feedback=fb, shed_ratio=0.5,
@@ -547,8 +555,10 @@ def test_router_sheds_deprioritized_class_under_pressure():
 
     reg = telemetry.MetricsRegistry()
     burn = telemetry.declare(reg, "slo_burn_rate")
-    burn.set(20.0, slo="latency_tight", window="fast_long")
-    burn.set(0.1, slo="latency_bulk", window="fast_long")
+    burn.set(20.0, slo="latency_tight", window="fast_long",
+             tenant="default")
+    burn.set(0.1, slo="latency_bulk", window="fast_long",
+             tenant="default")
     router = Router(
         example_shape=(4, 4, 3), registry=reg, max_queue=4,
         slo_classes="tight=50ms@30s,bulk=2s@60s", shed_queue_ratio=0.5,

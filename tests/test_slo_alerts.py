@@ -278,7 +278,7 @@ def test_evaluator_fires_resolves_and_publishes():
     ev.evaluate_once(10.0)
     assert ev.alerts["availability_fast_burn"].state == "inactive"
     assert reg.get("slo_burn_rate").value(
-        slo="availability", window="fast_long"
+        slo="availability", window="fast_long", tenant="default"
     ) == 0.0
     assert reg.get("autoscale_desired_replicas").value() == 1
 
@@ -293,7 +293,7 @@ def test_evaluator_fires_resolves_and_publishes():
         alert="availability_fast_burn", severity="page"
     ) == 1.0
     assert reg.get("slo_error_budget_remaining").value(
-        slo="availability"
+        slo="availability", tenant="default"
     ) < 0
     assert reg.get("autoscale_desired_replicas").value() == 2
 

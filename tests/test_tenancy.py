@@ -1,0 +1,357 @@
+"""Multi-tenant QoS: quotas, weighted-fair admission, dedupe pinning.
+
+ISSUE tentpole coverage: the tenant spec grammar and token buckets, the
+:class:`TenantAdmission` edge (shed-with-refill-hint BEFORE queue
+occupancy), deficit-weighted round robin fill, the rendezvous dedupe
+pin, the engine's quota edge + per-tenant telemetry, and the satellite
+goldens (quota convergence, 10:1 flood fairness / Jain's index).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from mpi4dl_tpu import telemetry  # noqa: E402
+from mpi4dl_tpu.evaluate import collect_batch_stats  # noqa: E402
+from mpi4dl_tpu.models.resnet import get_resnet_v2  # noqa: E402
+from mpi4dl_tpu.parallel.partition import init_cells  # noqa: E402
+from mpi4dl_tpu.serve import ServingEngine  # noqa: E402
+from mpi4dl_tpu.tenancy import (  # noqa: E402
+    DeficitRoundRobin,
+    QuotaExceededError,
+    Tenant,
+    TenantAdmission,
+    TokenBucket,
+    parse_tenants,
+    pin_order,
+    pin_replica,
+)
+from mpi4dl_tpu.utils import get_depth  # noqa: E402
+
+SIZE = 16
+
+
+@pytest.fixture(scope="module")
+def model():
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=SIZE // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, SIZE, SIZE, 3))
+    )
+    cal = [jnp.asarray(rng.standard_normal((4, SIZE, SIZE, 3)), jnp.float32)]
+    stats = collect_batch_stats(cells, params, cal)
+    return cells, params, stats
+
+
+def _engine(model, **kw):
+    cells, params, stats = model
+    kw.setdefault("example_shape", (SIZE, SIZE, 3))
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("default_deadline_s", 30.0)
+    return ServingEngine(cells, params, stats, **kw)
+
+
+def _examples(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.standard_normal((SIZE, SIZE, 3)).astype(np.float32)
+        for _ in range(n)
+    ]
+
+
+# -- spec grammar -------------------------------------------------------------
+
+
+def test_tenant_spec_grammar():
+    tens = parse_tenants("bulk=200:400,tight=50:100:4@tight+batch")
+    by = {t.name: t for t in tens}
+    assert set(by) == {"bulk", "tight", "default"}
+    assert by["bulk"].rate_rps == 200 and by["bulk"].burst == 400
+    assert by["bulk"].weight == 1.0 and by["bulk"].classes == ()
+    assert by["tight"].weight == 4.0
+    assert by["tight"].classes == ("tight", "batch")
+    # The implicit default tenant is unlimited — legacy clients land
+    # there unchanged.
+    assert by["default"].rate_rps is None
+    # 'none' = declared-but-unlimited, weight still settable.
+    (free, default) = parse_tenants("free=none:3")
+    assert free.rate_rps is None and free.weight == 3.0
+    # Errors are loud and name the problem.
+    with pytest.raises(ValueError, match="NAME=RPS"):
+        parse_tenants("bulk")
+    with pytest.raises(ValueError, match="BURST"):
+        parse_tenants("bulk=200")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_tenants("a=none,a=none")
+    with pytest.raises(ValueError, match="must match"):
+        parse_tenants("Bad-Name=none")
+    with pytest.raises(ValueError, match="rate must be"):
+        Tenant("x", rate_rps=-1)
+    with pytest.raises(ValueError, match="weight must be"):
+        Tenant("x", weight=0)
+    # burst defaults to one second of sustained rate.
+    assert Tenant("x", rate_rps=25).burst == 25.0
+
+
+def test_token_bucket_refill_hint_is_exact():
+    clock = [0.0]
+    b = TokenBucket(rate_rps=10.0, burst=2.0, clock=lambda: clock[0])
+    assert b.try_take() is None
+    assert b.try_take() is None
+    # Empty: the hint is the EXACT wall time until one token refills.
+    hint = b.try_take()
+    assert hint == pytest.approx(0.1)
+    # Sleeping exactly the hint admits — the convergence contract.
+    clock[0] += hint
+    assert b.try_take() is None
+    # Refill caps at burst, never banks beyond it.
+    clock[0] += 100.0
+    assert b.tokens() == pytest.approx(2.0)
+    # A multi-token take hints proportionally longer.
+    b.try_take(2)
+    assert b.try_take(2) == pytest.approx(0.2)
+
+
+def test_admission_sheds_with_hint_and_publishes_metrics():
+    clock = [0.0]
+    reg = telemetry.MetricsRegistry()
+    adm = TenantAdmission(
+        "bulk=5:2,vip=none@tight", registry=reg, clock=lambda: clock[0]
+    )
+    assert adm.weights() == {"bulk": 1.0, "vip": 1.0, "default": 1.0}
+    # In-quota admits count; the bucket gauge tracks the level.
+    adm.admit("bulk")
+    adm.admit("bulk")
+    assert reg.get("tenant_admitted_total").value(tenant="bulk") == 2
+    assert reg.get("tenant_quota_tokens").value(tenant="bulk") == 0.0
+    # Over-quota: typed shed carrying the refill hint, BEFORE any queue.
+    with pytest.raises(QuotaExceededError) as ei:
+        adm.admit("bulk", slo_class="batch")
+    e = ei.value
+    assert e.shed and e.tenant == "bulk" and e.slo_class == "batch"
+    assert e.retry_after_s == pytest.approx(0.2)
+    assert reg.get("tenant_quota_sheds_total").value(tenant="bulk") == 1
+    # Unlimited tenants never shed; unknown names are a config bug.
+    for _ in range(100):
+        adm.admit("vip", slo_class="tight")
+    with pytest.raises(ValueError, match="unknown tenant"):
+        adm.admit("nope")
+    # Class allowlist: a violation is ValueError (config), not a shed.
+    with pytest.raises(ValueError, match="may not submit"):
+        adm.admit("vip", slo_class="bulk")
+    # None lands in the implicit default tenant.
+    assert adm.admit(None).name == "default"
+    st = adm.state()
+    assert st["bulk"]["rate_rps"] == 5 and st["vip"]["tokens"] is None
+
+
+def test_deficit_round_robin_weighted_interleave():
+    d = DeficitRoundRobin({"a": 2.0, "b": 1.0})
+    seq = "".join(d.pick({"a", "b"}) for _ in range(9))
+    assert seq.count("a") == 6 and seq.count("b") == 3
+    # No starvation: b is served within every weight-sum window.
+    assert "b" in seq[:3] and "b" in seq[3:6] and "b" in seq[6:9]
+    # Work-conserving: an idle tenant forfeits banked credit — a burst
+    # arriving after idling gets no catch-up beyond its weight.
+    d2 = DeficitRoundRobin({"a": 1.0, "b": 1.0})
+    for _ in range(10):
+        assert d2.pick({"a"}) == "a"
+    seq2 = [d2.pick({"a", "b"}) for _ in range(10)]
+    assert seq2.count("b") == 5
+    with pytest.raises(ValueError, match="weights must be"):
+        DeficitRoundRobin({"a": 0.0})
+
+
+def test_rendezvous_pin_is_consistent_across_routers():
+    names = ["r0", "r1", "r2", "r3"]
+    tid = "trace-abc123"
+    order = pin_order(tid, names)
+    assert sorted(order) == sorted(names)
+    # Every router computes the identical ranking from (trace, names) —
+    # the property that lets independent routers agree on a pin with no
+    # coordination.
+    assert pin_order(tid, list(reversed(names))) == order
+    assert pin_replica(tid, names) == order[0]
+    # The head dying moves the pin to the SAME successor everywhere.
+    alive = [n for n in names if n != order[0]]
+    assert pin_replica(tid, alive) == order[1]
+    # Different traces spread across replicas (not all on one head).
+    heads = {pin_replica(f"t{i}", names) for i in range(32)}
+    assert len(heads) > 1
+    assert pin_replica(tid, []) is None
+
+
+# -- engine integration -------------------------------------------------------
+
+
+def test_engine_quota_shed_before_queue_slots(model):
+    """Tentpole: over-quota floods shed at submit with the bucket's
+    refill hint — no queue slot occupied, typed error, counted."""
+    eng = _engine(model, tenants="capped=1:2,free=none", max_queue=64)
+    xs = _examples(4)
+    eng.start()
+    try:
+        futs = [eng.submit(x, tenant="capped") for x in xs[:2]]
+        with pytest.raises(QuotaExceededError) as ei:
+            eng.submit(xs[2], tenant="capped")
+        assert ei.value.tenant == "capped"
+        assert ei.value.retry_after_s is not None
+        assert ei.value.retry_after_s > 0
+        # The shed consumed NO queue capacity and other tenants are
+        # untouched: free + untenanted traffic admits immediately.
+        futs.append(eng.submit(xs[2], tenant="free"))
+        futs.append(eng.submit(xs[3]))  # -> implicit default tenant
+        for f in futs:
+            f.result(timeout=60)
+        with pytest.raises(ValueError, match="unknown tenant"):
+            eng.submit(xs[0], tenant="ghost")
+    finally:
+        eng.stop()
+    s = eng.stats()
+    assert s["rejected_quota"] == 1
+    assert s["tenancy"]["capped"]["rate_rps"] == 1
+    reg = eng.registry
+    assert reg.get("tenant_quota_sheds_total").value(tenant="capped") == 1
+    assert reg.get("tenant_admitted_total").value(tenant="capped") == 2
+    assert reg.get("tenant_admitted_total").value(tenant="free") == 1
+    # Per-tenant latency forensics: the class histogram carries the
+    # tenant label per series.
+    by = {
+        (s["labels"]["slo_class"], s["labels"]["tenant"]): s["count"]
+        for s in reg.get("serve_class_latency_seconds").snapshot_series()
+    }
+    assert by[("default", "capped")] == 2
+    assert by[("default", "free")] == 1
+    assert by[("default", "default")] == 1
+
+
+def test_engine_off_path_unchanged(model):
+    """tenants=None is the zero-overhead path: no admission object, no
+    tenancy stats block, untenanted submit unchanged."""
+    eng = _engine(model)
+    eng.start()
+    try:
+        eng.submit(_examples(1)[0]).result(timeout=60)
+    finally:
+        eng.stop()
+    s = eng.stats()
+    assert "tenancy" not in s
+    assert s["rejected_quota"] == 0
+
+
+def test_quota_convergence_via_refill_hint(model):
+    """Satellite: a retrying client that sleeps EXACTLY retry_after_s
+    (the token bucket's refill time, not the batch-cadence EMA)
+    converges on the tenant's configured rate — all requests serve, and
+    the run takes at least the admission-rate floor."""
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    rate, burst, n = 50.0, 4.0, 20
+    eng = _engine(model, tenants=f"slow={rate:g}:{burst:g}", max_queue=64)
+    eng.start()
+    try:
+        t0 = time.monotonic()
+        rep = run_closed_loop(
+            eng, n, concurrency=4, deadline_s=30.0,
+            queue_full_retries=1000, tenant_mix={"slow": 1.0},
+        )
+        dt = time.monotonic() - t0
+    finally:
+        eng.stop()
+    assert rep["served"] == n and rep["rejected_quota"] == 0
+    # The shed/retry loop engaged (the burst alone can't carry n)...
+    assert rep["quota_shed_retries"] > 0
+    ten = rep["by_tenant"]["slow"]
+    assert ten["served"] == n and ten["quota_shed_retries"] > 0
+    # ...and the wall clock respects the bucket: n requests through a
+    # rate-r bucket with burst b take >= (n - b)/r seconds (0.8 margin
+    # for the final in-flight batch).
+    assert dt >= 0.8 * (n - burst) / rate
+    # Convergence, not thundering: the retry count stays within a small
+    # multiple of the shed count a compliant client would see.
+    assert rep["quota_shed_retries"] < 40 * n
+
+
+def test_fairness_two_tenant_flood_golden(model):
+    """Satellite golden: a 10:1 in-quota flood must not starve the
+    victim — DWRR batch fill bounds the victim's p99 at <= 1.5x its
+    solo p99, and weighted service stays fair (Jain's index)."""
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    def run(mix, n):
+        eng = _engine(
+            model, tenants="victim=none,bully=none",
+            max_queue=256, max_batch=4,
+        )
+        eng.start()
+        try:
+            return run_closed_loop(
+                eng, n, concurrency=16, deadline_s=60.0, tenant_mix=mix,
+            )
+        finally:
+            eng.stop()
+
+    solo = run({"victim": 1.0}, 24)
+    flood = run({"bully": 10.0, "victim": 1.0}, 110)
+    solo_p99 = solo["by_tenant"]["victim"]["latency_s"]["p99"]
+    flood_p99 = flood["by_tenant"]["victim"]["latency_s"]["p99"]
+    assert flood["by_tenant"]["victim"]["served"] >= 8
+    # The headline golden. 1.5x is the ISSUE's bound; CPU-jitter margin
+    # is already inside it because both sides run the same stack.
+    assert flood_p99 <= 1.5 * max(solo_p99, 0.05), (
+        f"victim p99 {flood_p99:.3f}s vs solo {solo_p99:.3f}s"
+    )
+    # Jain's fairness index over per-tenant weighted throughput: equal
+    # weights, offered 10:1 — service tracks offered load (both tenants
+    # in quota; fairness means neither is throttled below its share).
+    served = {
+        t: rec["served"] for t, rec in flood["by_tenant"].items()
+    }
+    offered = {"bully": 10.0, "victim": 1.0}
+    xs = [served[t] / offered[t] for t in served]
+    jain = sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs))
+    assert jain > 0.9, f"Jain index {jain:.3f} over {served}"
+
+
+# -- scheduler DWRR fill ------------------------------------------------------
+
+
+def test_scheduler_dwrr_fill_is_weight_proportional():
+    """With both tenants backlogged in one class, batch slots fill by
+    weight (2:1), deterministically — the noisy-neighbor mechanism."""
+    from mpi4dl_tpu.serve.scheduler import ClassScheduler, normalize_classes
+
+    class _Req:
+        def __init__(self, deadline, tenant, tag):
+            self.deadline = deadline
+            self.slo_class = "default"
+            self.tenant = tenant
+            self.tag = tag
+
+    s = ClassScheduler(
+        normalize_classes(None), max_queue=64, mode="edf",
+        tenants="heavy=none:2,light=none",
+    )
+    now = time.monotonic()
+    # The bully floods with EARLIER deadlines than the victim — EDF
+    # alone would serve all of heavy first; DWRR must interleave.
+    for i in range(12):
+        s.put(_Req(now + 1.0 + i * 1e-3, "heavy", f"h{i}"))
+    for i in range(6):
+        s.put(_Req(now + 10.0 + i * 1e-3, "light", f"l{i}"))
+    reqs, _ = s.take(18, first_timeout_s=0.5)
+    tags = [r.tag for r in reqs]
+    assert len(tags) == 18
+    # Every 3-slot window holds a light request: 2:1, no starvation.
+    light_positions = [i for i, t in enumerate(tags) if t.startswith("l")]
+    assert light_positions[0] <= 3
+    gaps = np.diff([-1] + light_positions)
+    assert max(gaps) <= 4, tags
+    # Per-tenant depth introspection drains to zero.
+    assert all(v == 0 for v in s.qsize_by_tenant()["default"].values())
