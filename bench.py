@@ -696,6 +696,114 @@ def _measure_fleet() -> dict:
             client.close()
 
 
+def _measure_coldstart() -> dict:
+    """Cold-start decomposition extra (docs/OBSERVABILITY.md "Cold
+    start"): two single-replica fleets, one ``kill -9`` each —
+
+    - arm ``cold``: no warm pool — recovery is a full respawn, and the
+      worker's ready handshake attributes every second of it across
+      ``spawn/import/construct/compile/warm/ready``;
+    - arm ``promote``: warm pool of 1 — recovery is a standby
+      promotion, attributed honestly as all ``ready`` (routing flip)
+      with ``compile == 0``: the phase evidence the pool's idle RAM
+      buys the skipped phases.
+
+    bench-history trends ``recovery_s.{cold,promote}`` and every
+    ``phase_s.{arm}.{phase}`` with the INVERTED sign; the headline
+    ``value`` is the promotion speedup (cold / promote recovery, normal
+    sign). The worker ledger dumps collected before teardown feed
+    ``analyze coldstart`` — the top executables by compile seconds land
+    in ``manifest``."""
+    import signal as _signal
+
+    from mpi4dl_tpu.analysis.coldstart import build_manifest
+    from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+
+    def drill(warm_pool: int) -> dict:
+        # --max-batch 4 → three serve buckets (1, 2, 4): the manifest's
+        # top-3 ranking has three real executables to name.
+        sup = FleetSupervisor(
+            ["--image-size", "16", "--max-batch", "4"],
+            router=None, registry=_REGISTRY,
+            replicas=1, max_replicas=1, warm_pool=warm_pool,
+            env=env,
+            reconcile_interval_s=0.1, backoff_base_s=0.1,
+            backoff_max_s=0.5, spawn_timeout_s=420.0,
+        )
+        try:
+            sup.start()
+            sup.wait_ready(timeout_s=420)
+            os.kill(sup.slot_by_index(0).pid, _signal.SIGKILL)
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if sup.last_recovery_s is not None and sup.running_count() >= 1:
+                    break
+                time.sleep(0.05)
+            # The replacement's ledger dump (written next to its ready
+            # file) must be read BEFORE close() tears the run dir down.
+            ledgers = []
+            for i in range(2):
+                slot = sup.slot_by_index(i)
+                path = (slot.ports or {}).get("ledger") if slot else None
+                if path and os.path.exists(path):
+                    ledgers.append(path)
+            manifest = (
+                build_manifest(ledgers, top=3) if ledgers else None
+            )
+            return {
+                "recovery_s": sup.last_recovery_s,
+                "phases": dict(sup.last_recovery_phases or {}),
+                "promotions": sup.promotions,
+                "manifest": manifest,
+            }
+        finally:
+            sup.close()
+
+    cold = drill(0)
+    promote = drill(1)
+    manifest = promote["manifest"] or cold["manifest"]
+    speedup = None
+    if cold["recovery_s"] and promote["recovery_s"]:
+        speedup = round(cold["recovery_s"] / promote["recovery_s"], 1)
+    return {
+        "value": speedup,
+        "unit": "x promotion speedup (cold respawn s / warm-pool "
+                "promote s, kill -9 to routable)",
+        "recovery_s": {
+            "cold": (
+                round(cold["recovery_s"], 2)
+                if cold["recovery_s"] is not None else None
+            ),
+            "promote": (
+                round(promote["recovery_s"], 2)
+                if promote["recovery_s"] is not None else None
+            ),
+        },
+        "phases": {
+            "cold": {k: round(v, 3) for k, v in cold["phases"].items()},
+            "promote": {
+                k: round(v, 3) for k, v in promote["phases"].items()
+            },
+        },
+        "promotions": promote["promotions"],
+        "top_executables": [
+            {
+                "executable": g["executable"],
+                "fingerprint": g["fingerprint"],
+                "compile_s": g["compile_s"],
+            }
+            for g in (manifest or {}).get("executables", [])
+        ],
+    }
+
+
 def _measure_multitenant() -> dict:
     """Multi-tenant QoS extra (docs/SERVING.md "Multi-tenancy"): one
     small engine, three closed-loop rounds —
@@ -1487,6 +1595,13 @@ def main():
     # -9): rps-through-the-fault, requeue count, recovery latency.
     if os.environ.get("BENCH_FLEET", "1") != "0":
         run_extra("fleet_2replica", _measure_fleet, est_seconds=240.0)
+
+    # Cold-start decomposition drill (telemetry/coldstart.py): a cold
+    # respawn vs a warm-pool promotion, each recovery attributed across
+    # spawn/import/construct/compile/warm/ready — bench-history trends
+    # every phase_s series INVERTED so no single phase regrows silently.
+    if os.environ.get("BENCH_COLDSTART", "1") != "0":
+        run_extra("coldstart", _measure_coldstart, est_seconds=180.0)
 
     # Multi-tenant QoS (tenancy subsystem): noisy-neighbor victim p99
     # ratio + Jain's fairness index under a 10:1 flood, and the

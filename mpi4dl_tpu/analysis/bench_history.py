@@ -153,6 +153,18 @@ def extract_series(result: dict) -> "dict[str, float]":
             for kind, v in recovery.items():
                 if isinstance(v, (int, float)):
                     out[f"{name}.recovery_s.{kind}"] = float(v)
+        # Cold-start extra: the per-phase recovery decomposition per arm
+        # ({"cold": {"compile": ...}, "promote": {...}}), trended with
+        # the INVERTED sign — a grown compile (or any other) phase is
+        # the regression the compile-cache work must not reintroduce.
+        phases = entry.get("phases")
+        if isinstance(phases, dict):
+            for arm, rec in phases.items():
+                if not isinstance(rec, dict):
+                    continue
+                for ph, v in rec.items():
+                    if isinstance(v, (int, float)):
+                        out[f"{name}.phase_s.{arm}.{ph}"] = float(v)
         # Serving extra: tail shape (p99/p50), trended with the
         # inverted sign — a growing tail is the regression even when
         # mean throughput holds.
@@ -226,7 +238,8 @@ def extract_series(result: dict) -> "dict[str, float]":
 
 def lower_is_better(key: str) -> bool:
     """Memory, latency, step-time, tail-shape, and bubble series regress
-    UPWARD: a grown footprint, a slower death-to-replacement, a slower SP
+    UPWARD: a grown footprint, a slower death-to-replacement (whole or
+    any single recovery phase — ``.phase_s.`` series), a slower SP
     train step, a fatter p99/p50 tail, a grown pipeline bubble, grown
     predicted comms time, or growing predicted-vs-measured cost-model
     drift is the failure, a shrunk one the improvement — the inverse of
@@ -239,6 +252,7 @@ def lower_is_better(key: str) -> bool:
     return (
         "peak_hbm_bytes" in key
         or ".recovery_s" in key
+        or ".phase_s." in key
         or ".step_time_s" in key
         or key.endswith(".tail_p99_p50_ratio")
         or ".sched_tight_p99_ms" in key

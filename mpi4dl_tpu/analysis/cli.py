@@ -52,7 +52,13 @@ seconds, achievable overlap ceiling, schedule-model bubble — publishes
 the ``hlolint_predicted_*`` gauges, and crosschecks against a live trace
 capture (``cost-model-crosscheck``); its ``--artifact`` mode prices
 committed lint-report JSONs with no jax at all
-(:mod:`mpi4dl_tpu.analysis.costmodel`).
+(:mod:`mpi4dl_tpu.analysis.costmodel`);
+``python -m mpi4dl_tpu.analyze coldstart LEDGER.json LOGS.jsonl ...``
+ranks executables by compile seconds across footprint-ledger dumps
+(grouped by content fingerprint), joins ``elastic.restart`` events and
+the fleet recovery phase decomposition, and gates on ``--budget-s`` —
+pure JSON, its ``--artifact`` mode needs no jax at all
+(:mod:`mpi4dl_tpu.analysis.coldstart`).
 """
 
 from __future__ import annotations
@@ -223,6 +229,15 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.costmodel import main as costmodel_main
 
         return costmodel_main(argv[1:])
+    if argv and argv[0] == "coldstart":
+        # Cold-start manifest: rank executables by compile seconds
+        # across footprint-ledger dumps, join elastic.restart events and
+        # fleet recovery phase decompositions. Pure JSON — runs on
+        # artifacts from a dead machine, dispatches before any backend
+        # setup like bench-history.
+        from mpi4dl_tpu.analysis.coldstart import main as coldstart_main
+
+        return coldstart_main(argv[1:])
     if argv and argv[0] == "memory-plan":
         # Feasibility planner. Its artifact mode (committed peaks vs a
         # limit) is pure JSON and must dispatch before any backend

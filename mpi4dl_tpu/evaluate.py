@@ -30,6 +30,7 @@ inference has no reason to pay halo exchanges.
 from __future__ import annotations
 
 import functools
+import time
 from collections.abc import Mapping
 from typing import Any, Sequence
 
@@ -159,6 +160,7 @@ def aot_compile_predict(
     example_shape: Sequence[int],
     buckets: Sequence[int],
     dtype=jnp.float32,
+    timings: "dict | None" = None,
 ) -> dict:
     """AOT-lower the frozen-stats forward once per batch bucket.
 
@@ -169,6 +171,12 @@ def aot_compile_predict(
     or compile, so a request loop built on these executables is
     structurally incapable of paying a surprise JIT (the serving engine's
     no-compile-after-warm-up guarantee rests on this).
+
+    When ``timings`` is a dict, each bucket's cold-start facts land in it
+    as ``{bucket: {"trace_s", "compile_s", "fingerprint"}}`` — the
+    trace/compile split plus the content fingerprint of the LOWERED
+    program (:mod:`mpi4dl_tpu.telemetry.coldstart`), destined for the
+    footprint ledger and ``compile_seconds{program, phase}``.
     """
     cells = tuple(cells)
 
@@ -180,7 +188,19 @@ def aot_compile_predict(
         if b < 1:
             raise ValueError(f"bucket sizes must be >= 1, got {b}")
         xs = jax.ShapeDtypeStruct((b, *tuple(example_shape)), dtype)
-        out[b] = jax.jit(fwd).lower(params, batch_stats, xs).compile()
+        t0 = time.perf_counter()
+        lowered = jax.jit(fwd).lower(params, batch_stats, xs)
+        t1 = time.perf_counter()
+        out[b] = lowered.compile()
+        t2 = time.perf_counter()
+        if timings is not None:
+            from mpi4dl_tpu.telemetry.coldstart import fingerprint_of
+
+            timings[b] = {
+                "trace_s": round(t1 - t0, 6),
+                "compile_s": round(t2 - t1, 6),
+                "fingerprint": fingerprint_of(lowered),
+            }
     return out
 
 
@@ -194,6 +214,7 @@ def aot_compile_tiled_predict(
     tile_buckets: Sequence[int],
     dtype=jnp.float32,
     feature_dtype=None,
+    timings: "dict | None" = None,
 ) -> dict:
     """AOT-lower the two halves of the tile-streaming forward
     (:mod:`mpi4dl_tpu.serve.tiled`): the SPATIAL SECTION (``cells[:split]``
@@ -226,17 +247,38 @@ def aot_compile_tiled_predict(
     def head_fwd(p, s, x):
         return _apply_running(head, p, s, x)
 
+    def _timed(fn, *args):
+        t0 = time.perf_counter()
+        lowered = fn.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        fp = None
+        if timings is not None:
+            from mpi4dl_tpu.telemetry.coldstart import fingerprint_of
+
+            fp = fingerprint_of(lowered)
+        return compiled, {
+            "trace_s": round(t1 - t0, 6),
+            "compile_s": round(t2 - t1, 6),
+            "fingerprint": fp,
+        }
+
     tile = {}
     for b in sorted({int(b) for b in tile_buckets}):
         if b < 1:
             raise ValueError(f"tile bucket sizes must be >= 1, got {b}")
         xs = jax.ShapeDtypeStruct((b, *tuple(window_shape)), dtype)
-        tile[b] = jax.jit(sec_fwd).lower(p_sec, s_sec, xs).compile()
+        tile[b], t = _timed(jax.jit(sec_fwd), p_sec, s_sec, xs)
+        if timings is not None:
+            timings[b] = t
     hs = jax.ShapeDtypeStruct(
         (1, *tuple(feature_shape)),
         feature_dtype if feature_dtype is not None else dtype,
     )
-    head_c = jax.jit(head_fwd).lower(p_head, s_head, hs).compile()
+    head_c, t = _timed(jax.jit(head_fwd), p_head, s_head, hs)
+    if timings is not None:
+        timings["head"] = t
     return {"tile": tile, "head": head_c}
 
 
@@ -369,6 +411,7 @@ def aot_compile_spatial_predict(
     example_shape: Sequence[int],
     buckets: Sequence[int],
     dtype=jnp.float32,
+    timings: "dict | None" = None,
 ) -> dict:
     """Sharded counterpart of :func:`aot_compile_predict`: AOT-lower the
     trainer's spatially-partitioned frozen-stats forward once per batch
@@ -417,6 +460,7 @@ def aot_compile_spatial_predict(
         )
     )
     x_sharding = NamedSharding(mesh, trainer.x_spec)
+    mesh_shape = tuple(mesh.devices.shape)
     out = {}
     for b in sorted({int(b) for b in buckets}):
         if b < 1:
@@ -424,7 +468,21 @@ def aot_compile_spatial_predict(
         xs = jax.ShapeDtypeStruct(
             (b, *tuple(example_shape)), dtype, sharding=x_sharding
         )
-        out[b] = fn.lower(params, batch_stats, xs).compile()
+        t0 = time.perf_counter()
+        lowered = fn.lower(params, batch_stats, xs)
+        t1 = time.perf_counter()
+        out[b] = lowered.compile()
+        t2 = time.perf_counter()
+        if timings is not None:
+            from mpi4dl_tpu.telemetry.coldstart import fingerprint_of
+
+            # The mesh shape feeds the fingerprint: the same forward on a
+            # 2x2 vs 1x4 tile grid is a different executable to cache.
+            timings[b] = {
+                "trace_s": round(t1 - t0, 6),
+                "compile_s": round(t2 - t1, 6),
+                "fingerprint": fingerprint_of(lowered, mesh_shape=mesh_shape),
+            }
     return out
 
 

@@ -53,6 +53,7 @@ SUPERVISOR_METRICS = (
     "fleet_replicas",
     "fleet_replica_restarts_total",
     "fleet_recovery_seconds",
+    "fleet_recovery_phase_seconds",
     "fleet_routers",
     "fleet_standby_replicas",
     "fleet_promotions_total",
@@ -236,6 +237,9 @@ class FleetSupervisor:
         self._m_recovery = telemetry.declare(
             self.registry, "fleet_recovery_seconds"
         )
+        self._m_recovery_phase = telemetry.declare(
+            self.registry, "fleet_recovery_phase_seconds"
+        )
         self._m_alert = telemetry.declare(self.registry, "alert_active")
         self._m_routers = telemetry.declare(self.registry, "fleet_routers")
         self._m_standby = telemetry.declare(
@@ -249,6 +253,7 @@ class FleetSupervisor:
         self._slots: "dict[str, _Slot]" = {}
         self.restarts = 0
         self.last_recovery_s: "float | None" = None
+        self.last_recovery_phases: "dict | None" = None
         self.last_router_recovery_s: "float | None" = None
         self.promotions = 0
 
@@ -386,6 +391,7 @@ class FleetSupervisor:
             "restarts": self.restarts,
             "promotions": self.promotions,
             "last_recovery_s": self.last_recovery_s,
+            "last_recovery_phases": self.last_recovery_phases,
             "last_router_recovery_s": self.last_router_recovery_s,
             "slots": slots,
         }
@@ -570,9 +576,34 @@ class FleetSupervisor:
             if victim.death_t is not None:
                 self.last_recovery_s = self._clock() - victim.death_t
                 self._m_recovery.set(self.last_recovery_s)
+                # A promotion's whole recovery is routable-again time
+                # (handshake + routing flip): compile/warm are honestly
+                # zero — the phase-attributed form of the warm pool's
+                # 0.05s-vs-7s claim.
+                self._publish_recovery_phases(
+                    self.last_recovery_s, {"ready": self.last_recovery_s}
+                )
                 victim.death_t = None
             return True
         return False
+
+    def _publish_recovery_phases(
+        self, recovery_s: float, worker_phases: "dict | None"
+    ) -> None:
+        """Publish fleet_recovery_phase_seconds{phase=} for the recovery
+        just measured: the worker's self-reported durations folded into
+        the fixed phase vocabulary, spawn = the supervisor-side residual,
+        EVERY phase set each time (zeros included) so cold respawns and
+        promotions alternating can't leave stale series behind and the
+        phases always sum to fleet_recovery_seconds."""
+        from mpi4dl_tpu.telemetry.coldstart import (
+            recovery_phase_decomposition,
+        )
+
+        phases = recovery_phase_decomposition(recovery_s, worker_phases)
+        for p, v in phases.items():
+            self._m_recovery_phase.set(v, phase=p)
+        self.last_recovery_phases = phases
 
     def _on_ready(self, slot: _Slot, ports: dict) -> None:
         slot.ports = ports
@@ -600,9 +631,14 @@ class FleetSupervisor:
         self._register_replica(slot)
         if slot.death_t is not None:
             # Death-to-replacement-serving: the fleet's recovery latency
-            # (bench-trended via the fleet_2replica extra).
+            # (bench-trended via the fleet_2replica extra), decomposed
+            # over the worker's self-reported cold-start phases (stub
+            # workers report none — the whole recovery lands in spawn).
             self.last_recovery_s = self._clock() - slot.death_t
             self._m_recovery.set(self.last_recovery_s)
+            self._publish_recovery_phases(
+                self.last_recovery_s, ports.get("phases")
+            )
             slot.death_t = None
 
     def _on_death(self, slot: _Slot, reason: str, kind: str) -> None:

@@ -408,6 +408,9 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
 
 
 def main(argv=None) -> int:
+    # Cold-start phase stamps (monotonic; only DURATIONS leave the
+    # process — clock-skew-safe for the supervisor's recovery math).
+    t_start = time.monotonic()
     args = build_parser().parse_args(argv)
 
     from mpi4dl_tpu.utils import apply_platform_env
@@ -435,6 +438,7 @@ def main(argv=None) -> int:
     from mpi4dl_tpu.serve import ServingEngine
     from mpi4dl_tpu.utils import get_depth
 
+    t_imports = time.monotonic()
     size = args.image_size
     engine_kw = dict(
         max_batch=args.max_batch, max_wait_s=args.max_wait_ms / 1e3,
@@ -501,6 +505,28 @@ def main(argv=None) -> int:
         )
         tiled_engine.start()
 
+    t_engine = time.monotonic()
+    # Worker-side recovery phase decomposition (telemetry.coldstart
+    # vocabulary, spawn = the supervisor-side residual): the AOT phase
+    # sums come from the engines' own warm-up ledgers, construct is the
+    # remaining engine-build wall (params init, BN calibration,
+    # device_put), ready is filled in at the handshake write below.
+    warmups = [engine.warmup_stats()]
+    if tiled_engine is not None:
+        warmups.append(tiled_engine.warmup_stats())
+    compile_s = sum(
+        w["totals"]["trace_s"] + w["totals"]["compile_s"] for w in warmups
+    )
+    warm_s = sum(w["totals"]["warm_s"] for w in warmups)
+    phases = {
+        "import": round(t_imports - t_start, 6),
+        "construct": round(
+            max(0.0, (t_engine - t_imports) - compile_s - warm_s), 6
+        ),
+        "compile": round(compile_s, 6),
+        "warm": round(warm_s, 6),
+    }
+
     chaos = _ChaosState()
     # Chaos seam: the wedge gate runs INSIDE the batcher thread's
     # dispatch, upstream of the real one — a wedged batcher with live
@@ -527,6 +553,12 @@ def main(argv=None) -> int:
         # tile_h x tile_w = a sharded forward. Routers/operators read
         # shard-for-model-size here, orthogonal to replica count.
         snap["mesh"] = list(engine.mesh_shape)
+        # Cold-start attribution: the same phase durations the ready
+        # handshake carried, plus the live warm-up decomposition — the
+        # supervisor (or an operator) reads where THIS incarnation's
+        # spawn time went off the one-endpoint scrape.
+        snap["phases"] = dict(phases)
+        snap["warmup"] = engine.warmup_stats()
         if tiled_engine is not None:
             # The gigapixel surface this replica additionally serves:
             # routers and operators read the geometry (and live request/
@@ -565,10 +597,28 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _sigterm)
     signal.signal(signal.SIGINT, _sigterm)
 
+    phases["ready"] = round(time.monotonic() - t_engine, 6)
+    # The footprint ledger (per-executable peaks + fingerprints +
+    # trace/compile/warm seconds) lands next to the ready file so a
+    # fleet-wide `analyze coldstart` has its inputs even after this
+    # process dies; the path rides the handshake.
+    ledger_path = args.ready_file + ".ledger.json"
+    try:
+        entries = engine.memory_ledger.entries()
+        if tiled_engine is not None:
+            entries += tiled_engine.memory_ledger.entries()
+        with open(ledger_path + ".tmp", "w") as f:
+            json.dump({"entries": entries}, f, indent=2)
+        os.replace(ledger_path + ".tmp", ledger_path)
+    except OSError:
+        ledger_path = None
+
     ready = {
         "pid": os.getpid(),
         "predict_port": predict_httpd.server_address[1],
         "metrics_port": metrics_server.port,
+        "phases": phases,
+        "ledger": ledger_path,
     }
     tmp = args.ready_file + ".tmp"
     with open(tmp, "w") as f:

@@ -98,6 +98,7 @@ import numpy as np
 
 from mpi4dl_tpu import telemetry
 from mpi4dl_tpu.profiling import annotate_step, percentiles
+from mpi4dl_tpu.telemetry import coldstart
 from mpi4dl_tpu.serve.batching import bucket_for, pad_batch, power_of_two_buckets
 from mpi4dl_tpu.serve.scheduler import (
     ClassFeedback,
@@ -242,6 +243,10 @@ class SingleChipPredictor:
         # the input batch only.
         self.params = jax.device_put(params, self.device)
         self.stats = jax.device_put(batch_stats, self.device)
+        # Per-bucket cold-start facts (trace_s/compile_s/fingerprint)
+        # from the last compile_bucket — the engine merges them into the
+        # footprint ledger entry it records for the same executable.
+        self.compile_timings: "dict[int, dict]" = {}
 
     @property
     def num_devices(self) -> int:
@@ -256,10 +261,13 @@ class SingleChipPredictor:
     def compile_bucket(self, bucket: int):
         from mpi4dl_tpu.evaluate import aot_compile_predict
 
-        return aot_compile_predict(
+        timings: dict = {}
+        out = aot_compile_predict(
             self.cells, self.params, self.stats, self.example_shape,
-            [bucket], dtype=self.dtype,
+            [bucket], dtype=self.dtype, timings=timings,
         )[bucket]
+        self.compile_timings[bucket] = timings.get(bucket, {})
+        return out
 
     def stage(self, batch):
         """Async host→device transfer of one padded batch."""
@@ -537,6 +545,7 @@ class ServingEngine:
         # to the buckets that fit.
         self._compiled = {}
         self.warm_latency_s: dict[int, float] = {}
+        _warmup_t0 = time.perf_counter()
         for b in self._buckets:
             try:
                 compiled = self._predictor.compile_bucket(b)
@@ -550,8 +559,12 @@ class ServingEngine:
                     registry=self.registry, events=self._events,
                 )
                 raise
+            # Cold-start facts measured inside compile_bucket (trace/
+            # compile split + the lowered program's fingerprint) ride the
+            # same ledger entry as the executable's predicted peak.
+            cold = getattr(self._predictor, "compile_timings", {}).get(b, {})
             entry = self.memory_ledger.record_compiled(
-                self._predictor.program, compiled, bucket=b
+                self._predictor.program, compiled, bucket=b, **cold
             )
             peak = entry.get("peak_bytes")
             if (
@@ -583,8 +596,15 @@ class ServingEngine:
             t0 = time.perf_counter()
             np.asarray(self._predictor.run(self._compiled[b], z))
             self.warm_latency_s[b] = time.perf_counter() - t0
+            # First-execute setup is the third cold-start phase: merge it
+            # into the bucket's ledger entry next to trace_s/compile_s.
+            self.memory_ledger.annotate(
+                self._predictor.program, bucket=b,
+                warm_s=round(self.warm_latency_s[b], 6),
+            )
         if hasattr(self._predictor, "warming"):
             self._predictor.warming = False
+        self.warmup_wall_s = time.perf_counter() - _warmup_t0
         self.assert_warm()
 
         # The continuous scheduler (or the fifo baseline): per-class
@@ -667,6 +687,12 @@ class ServingEngine:
         warm = decl("serve_warm_latency_seconds")
         for b, t in self.warm_latency_s.items():
             warm.set(t, bucket=b)
+        # Cold-start surface: total warm-up wall (compile loop + zeros
+        # runs — what a cold respawn pays before its ready handshake) and
+        # the compilation-cache honesty gauge. compile_seconds{program,
+        # phase} is accumulated by the footprint ledger itself.
+        decl("warmup_wall_seconds").set(self.warmup_wall_s)
+        self.cache_status = coldstart.publish_cache_status(self.registry)
         # Mesh facts of the serving forward: device count (1 = the
         # single-chip replica; tile_h*tile_w for a sharded one) and the
         # forward halo-shift permute count the sharded lint window is
@@ -1100,6 +1126,7 @@ class ServingEngine:
         out["buckets"] = list(self._buckets)
         out["mesh"] = list(self.mesh_shape)
         out["warm_latency_s"] = dict(self.warm_latency_s)
+        out["warmup"] = self.warmup_stats()
         out["healthy"] = self.health.healthy
         out["memory"] = self.memory_view()
         run_stats = getattr(self._predictor, "run_stats", None)
@@ -1108,6 +1135,31 @@ class ServingEngine:
             # (the loadgen report's `tiled` block reads this).
             out["tiled"] = run_stats()
         return out
+
+    def warmup_stats(self) -> dict:
+        """Cold-start decomposition of this engine's warm-up
+        (stats()/``/debugz``/the worker ready handshake): per-bucket
+        trace/compile/first-execute seconds + executable fingerprints
+        from the footprint ledger, phase totals, the warm-up wall, and
+        the compilation-cache status."""
+        buckets = {}
+        totals = {"trace_s": 0.0, "compile_s": 0.0, "warm_s": 0.0}
+        for b in sorted(self.warm_latency_s):
+            e = self.memory_ledger.get(self._predictor.program, bucket=b) or {}
+            rec = {
+                k: e.get(k)
+                for k in ("trace_s", "compile_s", "warm_s", "fingerprint")
+            }
+            buckets[str(b)] = rec
+            for k in totals:
+                if isinstance(rec.get(k), (int, float)):
+                    totals[k] += rec[k]
+        return {
+            "wall_s": round(self.warmup_wall_s, 6),
+            "buckets": buckets,
+            "totals": {k: round(v, 6) for k, v in totals.items()},
+            "cache": getattr(self, "cache_status", None),
+        }
 
     def memory_view(self) -> dict:
         """The memory observability surface (stats()/debugz): per-bucket

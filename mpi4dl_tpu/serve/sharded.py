@@ -180,6 +180,10 @@ class ShardedPredictor:
         self.stats = jax.device_put(batch_stats, repl)
         self._x_sharding = NamedSharding(mesh, trainer.x_spec)
         self._halo_shifts: "int | None" = None
+        # Per-bucket cold-start facts (trace_s/compile_s/fingerprint —
+        # the fingerprint folds the tile-mesh shape in) from the last
+        # compile_bucket; the engine merges them into the ledger entry.
+        self.compile_timings: "dict[int, dict]" = {}
 
     @property
     def num_devices(self) -> int:
@@ -200,11 +204,14 @@ class ShardedPredictor:
     def compile_bucket(self, bucket: int):
         from mpi4dl_tpu.evaluate import aot_compile_spatial_predict
 
+        timings: dict = {}
         with conv_overlap_env(self.conv_overlap):
-            return aot_compile_spatial_predict(
+            out = aot_compile_spatial_predict(
                 self.trainer, self.params, self.stats, self.example_shape,
-                [bucket], dtype=self.dtype,
+                [bucket], dtype=self.dtype, timings=timings,
             )[bucket]
+        self.compile_timings[bucket] = timings.get(bucket, {})
+        return out
 
     def stage(self, batch):
         """Async host→mesh transfer: the bucket lands tile-sharded

@@ -205,6 +205,27 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Structured RESOURCE_EXHAUSTED forensics (oom.report events) "
         "emitted, by program.",
     ),
+    # -- cold start (mpi4dl_tpu/telemetry/coldstart.py) ----------------------
+    "compile_seconds": MetricSpec(
+        "gauge", ("program", "phase"),
+        "Cumulative AOT cold-start seconds per program and phase — "
+        "trace (jit lower), compile (XLA), warm (first zeros "
+        "execution) — accumulated by the footprint ledger across "
+        "buckets; the series analyze coldstart ranks executables by.",
+    ),
+    "warmup_wall_seconds": MetricSpec(
+        "gauge", (),
+        "Wall seconds of the engine's whole AOT warm-up (compile loop "
+        "+ zeros runs) — the compile-bound part of a cold replica's "
+        "spawn-to-ready time.",
+    ),
+    "compile_cache_enabled": MetricSpec(
+        "gauge", (),
+        "1 when the persistent compilation cache is on, 0 when off — "
+        "including the jax-0.4.x segfault gate in "
+        "utils.enable_compilation_cache, so fleet runs are honest "
+        "about whether compiles are ever amortized.",
+    ),
     # -- tail forensics (mpi4dl_tpu/telemetry/tail.py) -----------------------
     "tail_samples_total": MetricSpec(
         "counter", (),
@@ -295,6 +316,15 @@ CATALOG: "dict[str, MetricSpec]" = {
         "Most recent death-to-replacement-serving duration: from a "
         "replica's confirmed death to its successor joining the router "
         "(trend-tracked by the fleet_2replica bench extra).",
+    ),
+    "fleet_recovery_phase_seconds": MetricSpec(
+        "gauge", ("phase",),
+        "Decomposition of the most recent fleet_recovery_seconds over "
+        "the fixed spawn/import/construct/compile/warm/ready phase "
+        "vocabulary (worker-reported durations riding the ready "
+        "handshake; spawn is the supervisor-side residual, so the "
+        "phases sum to the scalar). A warm-pool promotion is pure "
+        "ready time with compile/warm honestly zero.",
     ),
     "fleet_request_latency_seconds": MetricSpec(
         "histogram", (),

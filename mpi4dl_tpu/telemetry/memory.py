@@ -432,12 +432,20 @@ class FootprintLedger:
     program hold" exists *before* the first execution. Bucket entries
     publish ``serve_bucket_peak_hbm_bytes{bucket=}``; everything else
     publishes ``program_peak_hbm_bytes{program=}``.
+
+    Cold-start additions (:mod:`mpi4dl_tpu.telemetry.coldstart`): every
+    entry carries the executable's content ``fingerprint`` (the artifact-
+    store key — computed here, at the only place every AOT compile in
+    the codebase already passes through), and entries recorded with
+    ``trace_s`` / ``compile_s`` / ``warm_s`` phase durations accumulate
+    into the cataloged ``compile_seconds{program, phase}`` gauge.
+    ``dump()`` is the input of ``python -m mpi4dl_tpu.analyze coldstart``.
     """
 
     def __init__(self, registry=None):
         self._entries: "dict[str, dict]" = {}
         self._lock = threading.Lock()
-        self._m_bucket = self._m_program = None
+        self._m_bucket = self._m_program = self._m_compile = None
         if registry is not None:
             from mpi4dl_tpu import telemetry
 
@@ -449,6 +457,7 @@ class FootprintLedger:
             self._m_program = telemetry.declare(
                 registry, "program_peak_hbm_bytes"
             )
+            self._m_compile = telemetry.declare(registry, "compile_seconds")
 
     def record_compiled(
         self, program: str, compiled, bucket: "int | None" = None, **extra
@@ -466,6 +475,15 @@ class FootprintLedger:
             entry.update(summary)
         else:
             entry["peak_bytes"] = None
+        if entry.get("fingerprint") is None:
+            # Callers that timed the lowering pass the (preferable)
+            # pre-optimization fingerprint in extra; fall back to the
+            # optimized text so every entry still has an identity.
+            from mpi4dl_tpu.telemetry.coldstart import fingerprint_of
+
+            entry["fingerprint"] = fingerprint_of(
+                compiled, mesh_shape=extra.get("mesh_shape")
+            )
         key = program if bucket is None else f"{program}[{int(bucket)}]"
         with self._lock:
             self._entries[key] = entry
@@ -475,6 +493,7 @@ class FootprintLedger:
                 self._m_bucket.set(peak, bucket=int(bucket))
             elif bucket is None and self._m_program is not None:
                 self._m_program.set(peak, program=program)
+        self._publish_phases(program, entry)
         return entry
 
     def record_lowered(
@@ -483,9 +502,56 @@ class FootprintLedger:
         """Lower + compile a jitted callable on the given (abstract or
         concrete) arguments WITHOUT executing it, then record — a
         warm-cache no-op for programs the process already compiled
-        (XLA memoizes by program identity)."""
-        compiled = fn.lower(*args).compile()
+        (XLA memoizes by program identity). The trace/compile split is
+        timed here and the fingerprint taken from the LOWERED text (the
+        key a respawning worker could compute before paying the
+        compile)."""
+        from mpi4dl_tpu.telemetry.coldstart import fingerprint_of
+
+        t0 = time.perf_counter()
+        lowered = fn.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        extra.setdefault("trace_s", round(t1 - t0, 6))
+        extra.setdefault("compile_s", round(t2 - t1, 6))
+        extra.setdefault(
+            "fingerprint",
+            fingerprint_of(lowered, mesh_shape=extra.get("mesh_shape")),
+        )
         return self.record_compiled(program, compiled, bucket=bucket, **extra)
+
+    def annotate(
+        self, program: str, bucket: "int | None" = None, **extra
+    ) -> "dict | None":
+        """Merge late-arriving facts (the first-execute ``warm_s``, which
+        only exists after the engine's zeros run) into an existing entry;
+        phase durations publish into ``compile_seconds`` like recorded
+        ones. No-op on an unknown key."""
+        key = program if bucket is None else f"{program}[{int(bucket)}]"
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            entry.update(extra)
+            entry = dict(entry)
+        self._publish_phases(program, extra)
+        return entry
+
+    def _publish_phases(self, program: str, fields: dict) -> None:
+        """Accumulate any ``{trace,compile,warm}_s`` durations present in
+        ``fields`` into ``compile_seconds{program, phase}`` — cumulative
+        per program across buckets, the shape ``analyze coldstart`` and a
+        compile-cache A/B read. Entries marked ``rollup`` (the tiled
+        engine's per-image-bucket aggregate of its serve_tiled_* entries)
+        are skipped — their seconds are already published once by the
+        fine-grained entries they sum."""
+        if self._m_compile is None or fields.get("rollup"):
+            return
+        for phase in ("trace", "compile", "warm"):
+            v = fields.get(f"{phase}_s")
+            if isinstance(v, (int, float)):
+                self._m_compile.inc(float(v), program=program, phase=phase)
 
     def entries(self) -> "list[dict]":
         with self._lock:

@@ -1,7 +1,21 @@
 """Small helpers (parity with reference ``src/torchgems/utils.py``)."""
 
+import logging
 import os
 import re
+
+# Last enable_compilation_cache decision — read back by
+# telemetry.coldstart.publish_cache_status so fleet runs are honest about
+# cache state instead of silently paying compiles they believe cached.
+_CACHE_STATUS = {"enabled": False, "reason": "never attempted"}
+_CACHE_GATE_LOGGED = False
+
+
+def compilation_cache_status() -> dict:
+    """``{"enabled": bool, "reason": str, "dir": str|absent}`` of the last
+    :func:`enable_compilation_cache` call (reason "never attempted" when
+    nothing ever called it)."""
+    return dict(_CACHE_STATUS)
 
 
 def apply_platform_env() -> None:
@@ -38,9 +52,22 @@ def enable_compilation_cache(default_dir: str | None = None) -> None:
     the same sequence runs clean with the cache off). Paying the compiles
     again is strictly better than dying mid-suite/mid-bench.
     """
+    global _CACHE_GATE_LOGGED
     import jax
 
     if tuple(int(p) for p in jax.__version__.split(".")[:2]) < (0, 5):
+        reason = (
+            f"jax {jax.__version__} < 0.5: executing a persistent-cache-"
+            "deserialized executable segfaults on this line's multi-device "
+            "CPU backend — cache stays OFF, every compile is paid"
+        )
+        _CACHE_STATUS.clear()
+        _CACHE_STATUS.update({"enabled": False, "reason": reason})
+        if not _CACHE_GATE_LOGGED:
+            _CACHE_GATE_LOGGED = True
+            logging.getLogger("mpi4dl_tpu").warning(
+                "compilation cache disabled: %s", reason
+            )
         return
 
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
@@ -53,6 +80,10 @@ def enable_compilation_cache(default_dir: str | None = None) -> None:
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _CACHE_STATUS.clear()
+    _CACHE_STATUS.update(
+        {"enabled": True, "reason": "persistent cache on", "dir": cache_dir}
+    )
 
 
 def is_power_two(n: int) -> bool:

@@ -197,3 +197,53 @@ def test_costmodel_artifact_dispatches_pure_json(poison, tmp_path):
     assert pred["n_collectives"] == 2 and pred["n_async"] == 1
     assert pred["comms_s"] > 0 and pred["overlap_claim"] is True
     assert "poisoned" not in r.stderr
+
+
+def test_coldstart_dispatches_pure_json(poison, tmp_path):
+    """ISSUE 18 satellite: ``analyze coldstart --artifact`` joins ledger
+    dumps, elastic.restart JSONL events, and a fleet state report into
+    the executable manifest with jax poisoned — cold-start forensics
+    run on artifacts from a dead machine."""
+    ledger = tmp_path / "ledger.json"
+    ledger.write_text(json.dumps({"entries": [
+        {"program": "serve_predict", "bucket": 4, "peak_bytes": 2**20,
+         "fingerprint": "xfaaaaaaaaaaaaaaaa",
+         "trace_s": 0.2, "compile_s": 1.5, "warm_s": 0.01},
+        {"program": "serve_predict", "bucket": 1, "peak_bytes": 2**18,
+         "fingerprint": "xfbbbbbbbbbbbbbbbb",
+         "trace_s": 0.1, "compile_s": 0.5, "warm_s": 0.01},
+    ]}))
+    log = tmp_path / "telemetry.jsonl"
+    log.write_text(json.dumps({
+        "ts": 100.0, "kind": "event", "name": "elastic.restart",
+        "attrs": {"reason": "heartbeat_stale", "replica": 0},
+    }) + "\n")
+    fleet = tmp_path / "fleet.json"
+    fleet.write_text(json.dumps({
+        "last_recovery_s": 6.0,
+        "last_recovery_phases": {
+            "spawn": 0.5, "import": 1.5, "construct": 1.0,
+            "compile": 2.5, "warm": 0.3, "ready": 0.2,
+        },
+    }))
+    out = tmp_path / "manifest.json"
+    r = _run(
+        ["coldstart", str(ledger), str(log), str(fleet),
+         "--artifact", str(out), "--top", "3"],
+        poison,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "poisoned" not in r.stderr
+    doc = json.loads(out.read_text())
+    first = doc["executables"][0]
+    assert first["executable"] == "serve_predict[4]"
+    assert first["fingerprint"] == "xfaaaaaaaaaaaaaaaa"
+    assert doc["totals"]["compile_s"] == 2.0
+    assert doc["restarts"]["by_reason"] == {"heartbeat_stale": 1}
+    assert doc["recovery"]["phase_sum_s"] == 6.0
+    # The CI gate is part of the dispatch surface: over-budget exits 1,
+    # still without touching jax.
+    r = _run(["coldstart", str(ledger), "--budget-s", "1.0"], poison)
+    assert r.returncode == 1
+    assert "OVER BUDGET" in r.stderr
+    assert "poisoned" not in r.stderr

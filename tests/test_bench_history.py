@@ -107,6 +107,59 @@ def test_fleet_recovery_series_trended_and_inverted(tmp_path):
     assert by_key["fleet_2replica.recovery_s"]["verdict"] == "regressed"
 
 
+def test_coldstart_phase_series_trended_and_inverted(tmp_path):
+    """ISSUE 18 satellite: the coldstart extra's per-arm per-phase
+    recovery decomposition becomes ``{name}.phase_s.{arm}.{phase}``
+    trend series with the INVERTED sign — a grown compile (or any
+    other) phase is the regression, even when total recovery holds.
+    Rounds without the extra contribute nothing (absent-not-zero)."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    def with_coldstart(compile_s):
+        r = _result(7.0, 0.5)
+        r["extras"]["coldstart"] = {
+            "value": 700.0,
+            "recovery_s": {"cold": 7.2, "promote": 0.01},
+            "phases": {
+                "cold": {"spawn": 0.7, "import": 0.3, "construct": 1.0,
+                         "compile": compile_s, "warm": 0.1, "ready": 0.1},
+                "promote": {"spawn": 0.0, "compile": 0.0, "ready": 0.01},
+            },
+        }
+        return r
+
+    s = extract_series(with_coldstart(5.0))
+    assert s["coldstart.phase_s.cold.compile"] == 5.0
+    assert s["coldstart.phase_s.cold.spawn"] == 0.7
+    assert s["coldstart.phase_s.promote.compile"] == 0.0
+    assert s["coldstart.recovery_s.cold"] == 7.2
+    assert lower_is_better("coldstart.phase_s.cold.compile")
+    assert lower_is_better("coldstart.recovery_s.promote")
+    assert not lower_is_better("coldstart")  # the speedup headline
+
+    # compile 5.0 → 7.0 across rounds: CI fails on the phase series.
+    paths = _write_rounds(tmp_path, [
+        _round(1, 0, with_coldstart(5.0)),
+        _round(2, 0, with_coldstart(7.0)),
+    ])
+    assert main(paths) == 1
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(
+             zip(paths, [with_coldstart(5.0), with_coldstart(7.0)])
+         )],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["coldstart.phase_s.cold.compile"]["verdict"] == "regressed"
+    assert by_key["coldstart.phase_s.promote.compile"]["verdict"] == "flat"
+
+    # Absent-not-zero: an old round without the extra never reads as a
+    # zero-second cold start.
+    old = _result(7.0, 0.5)
+    assert not any(".phase_s." in k for k in extract_series(old))
+
+
 def test_tiled_gigapixel_series_trended_with_correct_signs(tmp_path):
     """ISSUE satellite: the tiled_gigapixel extra trends its capability
     point (peak_px — the largest image one chip served through the tile

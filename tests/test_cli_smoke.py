@@ -287,6 +287,48 @@ def test_analyze_memory_plan_cli(tmp_path, capsys):
     assert main(["memory-plan", "--baseline", str(empty)]) == 2
 
 
+def test_analyze_coldstart_cli(tmp_path, capsys):
+    """ISSUE 18 satellite: ``python -m mpi4dl_tpu.analyze coldstart``
+    through the CLI's real dispatch — ledger dumps ranked into the
+    top-executables manifest, the human-readable summary, and the
+    ``--budget-s`` CI exit code. Pure JSON, fast tier."""
+    from mpi4dl_tpu.analysis.cli import main
+
+    ledger = tmp_path / "ledger.json"
+    ledger.write_text(json.dumps({"entries": [
+        {"program": "serve_predict", "bucket": 4,
+         "fingerprint": "xf1111111111111111",
+         "trace_s": 0.2, "compile_s": 1.5, "warm_s": 0.02},
+        {"program": "serve_predict", "bucket": 1,
+         "fingerprint": "xf2222222222222222",
+         "trace_s": 0.1, "compile_s": 0.4, "warm_s": 0.01},
+        {"program": "train_step",
+         "fingerprint": "xf3333333333333333",
+         "trace_s": 0.5, "compile_s": 2.5, "warm_s": 0.1},
+    ]}))
+    rc = main(["coldstart", str(ledger), "--top", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # Ranked by compile seconds, --top truncates the listing.
+    assert "1. train_step xf3333333333333333" in out
+    assert "2. serve_predict[4]" in out
+    assert "serve_predict[1]" not in out
+    assert "compile 4.400s" in out
+
+    # Same ledger recorded twice (two replicas): fingerprint grouping
+    # merges each executable and counts occurrences.
+    rc = main(["coldstart", str(ledger), str(ledger), "--top", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "x2" in out and "compile 5.000s" in out
+
+    # The budget gate fails CI loudly.
+    rc = main(["coldstart", str(ledger), "--budget-s", "2.0"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "OVER BUDGET" in err
+
+
 def test_analyze_memory_plan_bisect_tile_cli(tmp_path, capsys):
     """ISSUE satellite: ``analyze memory-plan --bisect tile`` — the
     gigapixel pre-run question "what tile size fits this chip" answered

@@ -765,6 +765,17 @@ def test_supervisor_replaces_dead_replica_and_counts_restart(tmp_path):
         assert sup.last_recovery_s is not None
         assert sup.registry.get("fleet_recovery_seconds").value() \
             == sup.last_recovery_s
+        # ISSUE 18: cold-respawn phase attribution. This stub reports no
+        # phases in its ready handshake, so the whole recovery lands in
+        # the supervisor-side "spawn" residual — and the decomposition
+        # still sums exactly to fleet_recovery_seconds.
+        phases = sup.last_recovery_phases
+        assert phases is not None
+        assert phases["spawn"] == pytest.approx(sup.last_recovery_s)
+        assert sum(phases.values()) == pytest.approx(sup.last_recovery_s)
+        assert sup.registry.get("fleet_recovery_phase_seconds").value(
+            phase="spawn"
+        ) == pytest.approx(sup.last_recovery_s)
         # The restart landed as the schema-valid elastic.restart event.
         events.close()
         evs = telemetry.read_events(events.path)
@@ -974,6 +985,19 @@ def test_warm_pool_promotion_replaces_dead_replica_fast(tmp_path):
         assert [s["name"] for s in serving] == ["r1"]
         assert sup.last_recovery_s is not None
         assert sup.last_recovery_s < 5.0  # flip + handshake, not a spawn
+        # ISSUE 18: the phase decomposition attributes a promotion
+        # honestly — the whole recovery is routable-again time ("ready"),
+        # compile/warm ZERO (the phases the warm pool's idle RAM bought),
+        # and the published phases sum exactly to fleet_recovery_seconds.
+        phases = sup.last_recovery_phases
+        assert phases is not None
+        assert phases["compile"] == 0.0 and phases["warm"] == 0.0
+        assert phases["spawn"] == 0.0
+        assert phases["ready"] == pytest.approx(sup.last_recovery_s)
+        assert sum(phases.values()) == pytest.approx(sup.last_recovery_s)
+        g = sup.registry.get("fleet_recovery_phase_seconds")
+        assert g.value(phase="ready") == pytest.approx(sup.last_recovery_s)
+        assert g.value(phase="compile") == 0.0
         # The pool backfills: the victim slot respawns INTO standby.
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
