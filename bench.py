@@ -32,7 +32,10 @@ arms' measured bubble fraction + img/s (``BENCH_PIPELINE=0`` disables);
 ``tiled_gigapixel`` walks the largest image ONE chip serves through the
 halo-correct tile stream (serve/tiled.py) and measures fixed-size request
 latency + the tile/stitch split (``BENCH_TILED=0`` disables;
-``BENCH_TILED_PX``/``BENCH_TILED_TILE``/``BENCH_TILED_WALK`` scale it).
+``BENCH_TILED_PX``/``BENCH_TILED_TILE``/``BENCH_TILED_WALK`` scale it);
+``numerics`` measures the canary sentinel's ON/OFF rps tax and times a
+live bit-flip corrupt drill's corruption→fence detection latency
+(``BENCH_NUMERICS=0`` disables).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -905,6 +908,103 @@ def _measure_multitenant() -> dict:
     }
 
 
+def _measure_numerics() -> dict:
+    """Numerics sentinel extra (docs/OBSERVABILITY.md "Numerics"): one
+    small engine, two closed-loop arms plus a corrupt drill —
+
+    - ``off``: no canary sentinel — the zero-overhead baseline;
+    - ``on``: sentinel probing every 0.2s through the real dispatch
+      path (the deployment posture; docs target: within 2% rps);
+    - the drill: flip 3 bits in the live param buffer and time
+      corruption → fence (``canary.failure`` callback).
+
+    bench-history trends ``rps_overhead_pct`` and ``detect_s``, both
+    INVERTED — a grown canary tax or a slower detection regresses."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.evaluate import collect_batch_stats
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import init_cells
+    from mpi4dl_tpu.serve import ServingEngine
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.utils import get_depth
+
+    size = 16
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=size // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3))
+    )
+    stats = collect_batch_stats(
+        cells, params,
+        [jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)],
+    )
+
+    def mk_engine(**kw):
+        return ServingEngine(
+            cells, params, stats, example_shape=(size, size, 3),
+            max_batch=8, max_queue=512, default_deadline_s=60.0, **kw
+        )
+
+    n = 512
+    eng_off = mk_engine()
+    eng_off.start()
+    try:
+        # Warm-up pass first (same discipline as the multitenant A/B):
+        # compiles and allocator churn stay out of both arms.
+        run_closed_loop(eng_off, 64, concurrency=32, deadline_s=60.0)
+        off = run_closed_loop(eng_off, n, concurrency=32, deadline_s=60.0)
+    finally:
+        eng_off.stop()
+
+    interval = 0.2
+    eng = mk_engine(canary_interval_s=interval, registry=_REGISTRY)
+    fence_at: dict = {}
+    fenced = threading.Event()
+
+    def _on_failure(attrs):
+        fence_at.setdefault("t", time.perf_counter())
+        fence_at.setdefault("check", attrs.get("check"))
+        fenced.set()
+
+    eng.canary.on_failure(_on_failure)
+    eng.start()
+    try:
+        run_closed_loop(eng, 64, concurrency=32, deadline_s=60.0)
+        on = run_closed_loop(eng, n, concurrency=32, deadline_s=60.0)
+        # Corrupt drill AFTER the measured arm: detection latency is
+        # the metric here, the fenced engine's rps is not.
+        t0 = time.perf_counter()
+        forensics = eng.corrupt_params(bits=3)
+        detected = fenced.wait(timeout=max(10.0, 20 * interval))
+        view = eng.canary.view()
+    finally:
+        eng.stop()
+
+    on_rps = on["throughput_rps"]
+    off_rps = off["throughput_rps"]
+    entry = {
+        "value": round(on_rps, 1),
+        "unit": "requests/sec with canary sentinel on",
+        "off_rps": round(off_rps, 1),
+        "rps_overhead_pct": round((off_rps - on_rps) / off_rps * 100.0, 2),
+        "canary_interval_s": interval,
+        "detected": bool(detected),
+        "detect_check": fence_at.get("check"),
+        "corrupt": {"bits": 3, "leaf": forensics.get("leaf")},
+        "canary_checks": view.get("checks"),
+        "canary_failures": view.get("failures"),
+    }
+    if detected:
+        entry["detect_s"] = round(fence_at["t"] - t0, 3)
+    return entry
+
+
 def _measure_sp_overlap() -> dict:
     """SP 2×2 halo/compute-overlap A/B extra: run the spatially-
     partitioned train step with the monolithic AND the decomposed conv
@@ -1609,6 +1709,12 @@ def main():
     # INVERTED and fairness normal-sign.
     if os.environ.get("BENCH_MULTITENANT", "1") != "0":
         run_extra("multitenant", _measure_multitenant, est_seconds=150.0)
+
+    # Numerics sentinel A/B + corrupt drill: canary-on vs -off rps and
+    # the corruption→fence detection latency — bench-history trends
+    # both INVERTED (a grown canary tax or slower detection regresses).
+    if os.environ.get("BENCH_NUMERICS", "1") != "0":
+        run_extra("numerics", _measure_numerics, est_seconds=120.0)
 
     # SP 2x2 halo/compute overlap A/B (CPU-mesh subprocess): both conv
     # impls' measured trace_overlap_ratio + step time in one round, so

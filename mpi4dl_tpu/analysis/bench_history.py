@@ -200,6 +200,17 @@ def extract_series(result: dict) -> "dict[str, float]":
         fi = entry.get("fairness_index")
         if isinstance(fi, (int, float)):
             out[f"{name}.fairness_index"] = float(fi)
+        # Numerics sentinel extra: corrupt-drill detection latency and
+        # the canary-on throughput tax vs the off baseline, both with
+        # the INVERTED sign — slower detection or a grown overhead is
+        # the regression (docs target: ≤2% rps). Old rounds without the
+        # extra contribute nothing (absent-not-zero).
+        det = entry.get("detect_s")
+        if isinstance(det, (int, float)):
+            out[f"{name}.detect_s"] = float(det)
+        ov = entry.get("rps_overhead_pct")
+        if isinstance(ov, (int, float)):
+            out[f"{name}.rps_overhead_pct"] = float(ov)
         # Overlap A/B extras (sp2x2_overlap, serving_sharded): per-arm
         # measured overlap ratio (falling fails), SP train-step time
         # (growing fails), and — serving arms only — per-request p99
@@ -248,9 +259,13 @@ def lower_is_better(key: str) -> bool:
     normal direction: FALLING overlap fails CI). The multitenant
     ``victim_p99_ratio`` is inverted too — a growing victim tail under
     the flood is lost isolation — while ``fairness_index`` keeps the
-    normal direction."""
+    normal direction. The numerics sentinel's ``detect_s``
+    (corruption-to-fence latency) and ``rps_overhead_pct`` (canary-on
+    throughput tax) both regress upward."""
     return (
         "peak_hbm_bytes" in key
+        or key.endswith(".detect_s")
+        or key.endswith(".rps_overhead_pct")
         or ".recovery_s" in key
         or ".phase_s." in key
         or ".step_time_s" in key

@@ -58,7 +58,13 @@ ranks executables by compile seconds across footprint-ledger dumps
 (grouped by content fingerprint), joins ``elastic.restart`` events and
 the fleet recovery phase decomposition, and gates on ``--budget-s`` —
 pure JSON, its ``--artifact`` mode needs no jax at all
-(:mod:`mpi4dl_tpu.analysis.coldstart`).
+(:mod:`mpi4dl_tpu.analysis.coldstart`);
+``python -m mpi4dl_tpu.analyze numerics`` audits the three serving
+forwards — single-chip, spatially sharded, halo-tiled — against each
+other on the SAME deterministic canary batch and one weight set, gated
+per pair at the documented f32 tolerances; its ``--artifact`` mode
+re-gates committed audit reports and summarizes ``canary.failure``
+events with no jax at all (:mod:`mpi4dl_tpu.analysis.numerics`).
 """
 
 from __future__ import annotations
@@ -238,6 +244,16 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.coldstart import main as coldstart_main
 
         return coldstart_main(argv[1:])
+    if argv and argv[0] == "numerics":
+        # Cross-predictor canary equivalence audit (single-chip vs
+        # sharded vs tiled at the documented f32 tolerances). Its
+        # --artifact mode (re-gate committed audit reports, summarize
+        # canary.failure JSONL events) is pure JSON and dispatches
+        # before any backend setup, like bench-history; the live mode
+        # sets up its own CPU mesh like sp-overlap.
+        from mpi4dl_tpu.analysis.numerics import main as numerics_main
+
+        return numerics_main(argv[1:])
     if argv and argv[0] == "memory-plan":
         # Feasibility planner. Its artifact mode (committed peaks vs a
         # limit) is pure JSON and must dispatch before any backend

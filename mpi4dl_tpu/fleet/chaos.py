@@ -27,7 +27,12 @@ operator can rehearse them against a live fleet:
   NOISY-NEIGHBOR shape: the front door's token-bucket quota must shed
   the flood with ``retry_after_s`` before it occupies queue slots, and
   the deficit-weighted fill must hold the victim tenants' p99 —
-  docs/SERVING.md "Multi-tenancy").
+  docs/SERVING.md "Multi-tenancy");
+- ``corrupt`` — flip bits in the replica's LIVE parameter buffer
+  (the SILENT-CORRUPTION shape: full availability, wrong answers —
+  only the numerics sentinel's canary/checksum audit names it; the
+  drill proves detect → ``numerics_divergence`` page → quarantine —
+  docs/OBSERVABILITY.md "Numerics").
 
 Spec grammar (``--chaos``, repeatable)::
 
@@ -42,15 +47,18 @@ Spec grammar (``--chaos``, repeatable)::
     delay:1=0.3@2   slow r1's serving path by 0.3s/batch from t=+2s
     flood:bulk=500@2     offer 500 rps AS TENANT 'bulk' from t=+2s
                          (a fixed 2s burst through the front door)
+    corrupt:1@2     flip 3 bits in r1's largest param leaf at t=+2s
+    corrupt:1=8@2   ... 8 bits
 
 ``TARGET`` is the replica *slot index* (default 0) — or
 ``router[:INDEX]`` to target a front-door router process instead
 (``kill`` only: routers have no in-process ``/chaos`` surface; their
 failure mode IS hard death) — or, for ``flood``, the tenant NAME to
 flood as. ``AT`` is seconds after the load run starts; ``=SECONDS``
-(delay / delay-scrape) is the added latency, and ``=RPS`` (flood) is
-the burst's offered rate. Parsing is pure stdlib — ``--plan`` dispatch
-and the CLI smoke never touch a backend.
+(delay / delay-scrape) is the added latency, ``=RPS`` (flood) is the
+burst's offered rate, and ``=BITS`` (corrupt) is how many bits to
+flip. Parsing is pure stdlib — ``--plan`` dispatch and the CLI smoke
+never touch a backend.
 """
 
 from __future__ import annotations
@@ -60,7 +68,10 @@ import re
 import threading
 import time
 
-ACTIONS = ("kill", "wedge", "blackhole", "delay-scrape", "delay", "flood")
+ACTIONS = (
+    "kill", "wedge", "blackhole", "delay-scrape", "delay", "flood",
+    "corrupt",
+)
 
 _SPEC_RE = re.compile(
     r"^(?P<action>[a-z-]+)"
@@ -79,7 +90,8 @@ class ChaosOp:
     action: str
     target: int = 0        # slot index within the target domain
     at_s: float = 1.0      # seconds after the load run starts
-    seconds: float = 3.0   # delay-scrape only: added latency
+    seconds: float = 3.0   # delay/delay-scrape: added latency;
+    #                        corrupt: BITS to flip (same =N spec field)
     domain: str = "replica"  # "replica" | "router" | "tenant"
     tenant: str = ""       # flood only: the tenant to flood as
     rps: float = 0.0       # flood only: the burst's offered rate
@@ -111,10 +123,18 @@ class ChaosOp:
             )
         if self.target < 0 or self.at_s < 0 or self.seconds <= 0:
             raise ValueError(f"invalid chaos op: {self}")
+        if self.action == "corrupt" and self.seconds < 1:
+            raise ValueError(
+                f"corrupt needs at least 1 bit to flip "
+                f"(corrupt:REPLICA[=BITS]), got {self.seconds!r}"
+            )
 
     def describe(self) -> str:
         if self.action == "flood":
             return f"flood:{self.tenant}={self.rps:g}rps@+{self.at_s:g}s"
+        if self.action == "corrupt":
+            extra = f"={int(self.seconds)}b"
+            return f"corrupt:r{self.target}{extra}@+{self.at_s:g}s"
         extra = (
             f"={self.seconds:g}s"
             if self.action in ("delay-scrape", "delay") else ""
@@ -214,6 +234,9 @@ def inject(op: ChaosOp, supervisor, flood=None) -> dict:
         "blackhole": {"action": "blackhole_healthz"},
         "delay-scrape": {"action": "delay_scrape", "seconds": op.seconds},
         "delay": {"action": "delay_predict", "seconds": op.seconds},
+        # corrupt: BITS rides the generic seconds field; the worker's
+        # chaos endpoint flips that many bits in the live param buffer.
+        "corrupt": {"action": "corrupt_params", "seconds": op.seconds},
     }
     record.update(slot.client.chaos(**actions[op.action]))
     return record

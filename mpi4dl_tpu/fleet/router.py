@@ -157,6 +157,7 @@ class _Replica:
         self.client = ReplicaClient(name, predict_url)
         self.health_url = health_url.rstrip("/") + "/healthz"
         self.healthy = True          # optimistic until the first scrape
+        self.fenced = False          # numerics fence self-report (scraped)
         self.queue_depth: "float | None" = None
         self.scrape_failures = 0
         self.backoff_until = 0.0
@@ -182,6 +183,7 @@ class _Replica:
         return {
             "name": self.name,
             "healthy": self.healthy,
+            "fenced": self.fenced,
             "draining": self.draining,
             "removed": self.removed,
             "queue_depth": self.queue_depth,
@@ -1184,6 +1186,10 @@ class Router:
             if reachable:
                 rep.scrape_failures = 0
                 rep.healthy = bool(payload.get("healthy"))
+                # A numerics-fenced replica self-reports healthy=False
+                # (so the generic path already stops pulling); keep the
+                # distinct flag so admin views name WHY it was benched.
+                rep.fenced = bool(payload.get("fenced"))
                 if payload.get("queue_depth") is not None:
                     rep.queue_depth = float(payload["queue_depth"])
             if rep.healthy and not rep.removed:

@@ -800,16 +800,17 @@ class FleetSupervisor:
 
     # -- health probing -------------------------------------------------------
 
-    def _probe_unhealthy(self, slot: _Slot) -> bool:
-        """One supervisor-side ``/healthz`` probe: True when the replica
-        answered 503 or didn't answer (black-holed probes count — the
-        timeout IS the signal)."""
+    def _probe_health(self, slot: _Slot) -> "dict | None":
+        """One supervisor-side ``/healthz`` probe: the payload dict when
+        the replica answered (200 OR 503 — a 503 body still carries the
+        numerics fence evidence), None when it didn't answer at all
+        (black-holed probes count — the timeout IS the signal)."""
         import json
         import urllib.error
         import urllib.request
 
         if slot.ports is None:
-            return False
+            return {"healthy": True}
         url = (
             f"http://127.0.0.1:{slot.ports['metrics_port']}/healthz"
         )
@@ -817,11 +818,19 @@ class FleetSupervisor:
             with urllib.request.urlopen(
                 url, timeout=self._scrape_timeout_s
             ) as resp:
-                return not json.loads(resp.read().decode()).get("healthy")
-        except urllib.error.HTTPError:
-            return True   # 503: reachable and saying NO
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:  # 503: reachable and saying NO — keep the evidence
+                return json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001 — unparseable 503 body
+                return {"healthy": False}
         except Exception:  # noqa: BLE001 — unreachable/black-holed
-            return True
+            return None
+
+    def _probe_unhealthy(self, slot: _Slot) -> bool:
+        """True when the replica answered 503 or didn't answer."""
+        payload = self._probe_health(slot)
+        return payload is None or not payload.get("healthy")
 
     # -- reconcile loop -------------------------------------------------------
 
@@ -864,7 +873,15 @@ class FleetSupervisor:
                     "heartbeat",
                 )
                 return
-        if self._probe_unhealthy(slot):
+        payload = self._probe_health(slot)
+        if payload is not None and payload.get("fenced"):
+            # Numerics quarantine: the replica's own sentinel proved
+            # corruption and latched the fence — a self-report, not a
+            # flaky probe, so it skips the unhealthy streak entirely.
+            # One tick from fence to replacement spawning.
+            self._quarantine(slot, payload)
+            return
+        if payload is None or not payload.get("healthy"):
             slot.unhealthy_streak += 1
             if slot.unhealthy_streak >= self._unhealthy_after:
                 slot.proc.kill_hard()
@@ -875,6 +892,36 @@ class FleetSupervisor:
                 )
         else:
             slot.unhealthy_streak = 0
+
+    def _quarantine(self, slot: _Slot, payload: dict) -> None:
+        """Remove a numerics-fenced replica from service: tell every
+        router to stop pulling (the fence already 503s anything in
+        flight — the drain is about the routers' books, and is bounded
+        by one drain timeout), kill it, and route into the standard
+        death path with the distinct ``reason="numerics"`` restart
+        label. Repeat offenders trip the same RestartBreaker /
+        circuit-open page as any crash loop — a replica that corrupts
+        every incarnation must stop being respawned."""
+        evidence = payload.get("fence_evidence") or {}
+        if self.router is not None:
+            self.router.drain_replica(
+                slot.name, timeout_s=self._drain_timeout_s
+            )
+        for admin in self._router_admins():
+            try:
+                admin.replica_op(
+                    "drain", name=slot.name,
+                    timeout_s=self._drain_timeout_s,
+                )
+            except Exception:  # noqa: BLE001 — the kill below still
+                pass  # removes the replica from every router's scrape
+        slot.proc.kill_hard()
+        self._on_death(
+            slot,
+            "numerics fence: "
+            + str(evidence.get("check") or "canary divergence"),
+            "numerics",
+        )
 
     def _check_starting(self, slot: _Slot, now: float) -> None:
         del now  # the spawn age is measured on the process handle's own

@@ -125,17 +125,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "(NAME=RPS:BURST[:WEIGHT][@CLASSES], comma-"
                         "separated; NAME=none = unlimited) — must match "
                         "the router's tenants for tenant propagation")
+    p.add_argument("--canary-interval", type=float, default=10.0,
+                   help="numerics-sentinel cadence, seconds "
+                        "(telemetry/canary.py): golden probe through "
+                        "the real dispatch path + params-checksum "
+                        "re-audit; a divergence FENCES this replica "
+                        "(healthz unhealthy + /predict 503) until the "
+                        "supervisor respawns it. 0 disables the daemon "
+                        "(references and the load checksum still "
+                        "record)")
     return p
 
 
 class _ChaosState:
     """The worker-side fault switches the /chaos endpoint flips."""
 
-    def __init__(self):
+    def __init__(self, engine=None):
         self.wedged = threading.Event()
         self.blackhole_healthz = False
         self.scrape_delay_s = 0.0
         self.predict_delay_s = 0.0
+        # The corrupt drill's engine handle (set in main(); None in the
+        # soft-action unit tests that never corrupt).
+        self.engine = engine
 
     def apply(self, action: str, seconds: float = 0.0) -> dict:
         if action == "wedge":
@@ -148,6 +160,17 @@ class _ChaosState:
             self.scrape_delay_s = float(seconds)
         elif action == "delay_predict":
             self.predict_delay_s = float(seconds)
+        elif action == "corrupt_params":
+            # The corrupt drill: flip bits in the LIVE param buffer
+            # (telemetry/canary.py) — the spec's BITS rides the generic
+            # seconds field. Deliberately leaves checksums/references
+            # untouched: the sentinel must discover the damage.
+            if self.engine is None:
+                raise ValueError("no engine bound for corrupt_params")
+            forensics = self.engine.corrupt_params(
+                bits=int(seconds) if seconds else 3
+            )
+            return {"ok": True, "applied": action, "forensics": forensics}
         else:
             raise ValueError(f"unknown chaos action {action!r}")
         return {"ok": True, "applied": action}
@@ -236,8 +259,37 @@ class _ServedCache:
             ]
 
 
+class _NumericsFence:
+    """The worker's quarantine latch: set by the canary's on_failure
+    callback the moment the sentinel proves corruption. Once set, this
+    replica refuses /predict (503 ``numerics_fenced``) — checked at
+    admission AND again when a result comes back, so an answer computed
+    before detection but delivered after it is withheld too. The router
+    treats the 503 like any unreachable replica (mark unhealthy +
+    requeue elsewhere); the supervisor sees healthz go red and respawns.
+    One-way by design: only a process replacement (fresh params, fresh
+    references) clears a numerics fence."""
+
+    def __init__(self):
+        self.fenced = threading.Event()
+        self.evidence: "dict | None" = None
+        self._lock = threading.Lock()
+
+    def trip(self, attrs: dict) -> None:
+        with self._lock:
+            if self.evidence is None:
+                self.evidence = {"ts": time.time(), **attrs}
+        self.fenced.set()
+
+    def view(self) -> "dict | None":
+        with self._lock:
+            return dict(self.evidence) if self.evidence else None
+
+
 def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
-                    port: int, tiled_engine=None) -> ThreadingHTTPServer:
+                    port: int, tiled_engine=None,
+                    fence: "_NumericsFence | None" = None,
+                    ) -> ThreadingHTTPServer:
     from mpi4dl_tpu.serve.engine import (
         DeadlineExceededError,
         DrainedError,
@@ -298,6 +350,12 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
         def _predict(self, req: dict, engine=engine) -> None:
             if draining.is_set():
                 self._reply(503, {"ok": False, "error": "draining"})
+                return
+            if fence is not None and fence.fenced.is_set():
+                # Admission-side of the numerics fence: covers fresh
+                # submits AND the idempotency-cache/join fast paths — a
+                # corrupted replica must not answer even from cache.
+                self._reply(503, {"ok": False, "error": "numerics_fenced"})
                 return
             # Idempotency by trace id: a duplicate of a COMPLETED request
             # (client failover retry or a successor router's journal
@@ -378,6 +436,16 @@ def _predict_server(engine, chaos: _ChaosState, draining: threading.Event,
                     "ok": False, "error": f"{type(e).__name__}: {e}",
                 })
                 return
+            if fence is not None and fence.fenced.is_set():
+                # Response-side re-check: the answer resolved, but the
+                # sentinel proved corruption while it was in flight —
+                # the computation is suspect, so it is withheld. The
+                # router requeues on a healthy replica; exactly-once
+                # holds because nothing was delivered.
+                if tid:
+                    cache.finish(tid, None)
+                self._reply(503, {"ok": False, "error": "numerics_fenced"})
+                return
             logits = np.asarray(logits)
             payload = {
                 "ok": True,
@@ -452,6 +520,7 @@ def main(argv=None) -> int:
         slo_classes=args.slo_classes,
         scheduler=args.scheduler,
         tenants=args.tenants,
+        canary_interval_s=args.canary_interval or None,
     )
     if mesh_shape is not None:
         # Sharded replica: this process claims a device SUBSET shaped
@@ -527,7 +596,7 @@ def main(argv=None) -> int:
         "warm": round(warm_s, 6),
     }
 
-    chaos = _ChaosState()
+    chaos = _ChaosState(engine=engine)
     # Chaos seam: the wedge gate runs INSIDE the batcher thread's
     # dispatch, upstream of the real one — a wedged batcher with live
     # submit/HTTP/heartbeat threads, which is the failure shape the
@@ -541,6 +610,19 @@ def main(argv=None) -> int:
     engine._dispatch = gated_dispatch
 
     draining = threading.Event()
+    fence = _NumericsFence()
+
+    def _on_canary_failure(attrs: dict) -> None:
+        # The sentinel proved corruption: latch the fence (503s every
+        # /predict from here on) and flip the engine's own health flag
+        # so /healthz, the serve_healthy gauge, and the heartbeat all
+        # tell the same story the supervisor acts on.
+        fence.trip(attrs)
+        engine.health.set_unhealthy(
+            f"numerics divergence: {attrs.get('check')}"
+        )
+
+    engine.canary.on_failure(_on_canary_failure)
 
     def health_payload() -> dict:
         if chaos.blackhole_healthz:
@@ -549,6 +631,17 @@ def main(argv=None) -> int:
         snap["queue_depth"] = engine.queue_depth()
         snap["draining"] = draining.is_set()
         snap["pid"] = os.getpid()
+        # Numerics-sentinel surface: the params checksum + canary
+        # verdicts (federation compares these across replicas), and the
+        # fence latch. A fenced replica is unhealthy REGARDLESS of the
+        # underlying HealthState — the watchdog may flip that back to
+        # healthy when residual batches complete, but a numerics fence
+        # only clears by process replacement.
+        snap["numerics"] = engine.canary.view()
+        snap["fenced"] = fence.fenced.is_set()
+        if fence.fenced.is_set():
+            snap["healthy"] = False
+            snap["fence_evidence"] = fence.view()
         # The device subset this replica claims: (1,1) = one chip,
         # tile_h x tile_w = a sharded forward. Routers/operators read
         # shard-for-model-size here, orthogonal to replica count.
@@ -566,15 +659,22 @@ def main(argv=None) -> int:
             snap["tiled"] = tiled_engine.stats().get("tiled")
         return snap
 
+    def numerics_payload() -> dict:
+        snap = dict(engine.canary.view())
+        snap["fenced"] = fence.fenced.is_set()
+        return snap
+
     metrics_server = telemetry.MetricsServer(
         _DelayedRegistry(engine.registry, chaos),
         port=args.metrics_port,
         health=health_payload,
         debug=engine._debugz,
         alerts=engine.slo.state if engine.slo is not None else None,
+        numerics=numerics_payload,
     )
     predict_httpd = _predict_server(
-        engine, chaos, draining, args.port, tiled_engine=tiled_engine
+        engine, chaos, draining, args.port, tiled_engine=tiled_engine,
+        fence=fence,
     )
 
     heartbeat = None
@@ -619,6 +719,10 @@ def main(argv=None) -> int:
         "metrics_port": metrics_server.port,
         "phases": phases,
         "ledger": ledger_path,
+        # The load-time parameter-integrity baseline: a supervisor (or
+        # operator) can compare this across a fleet's handshakes before
+        # any traffic flows — same checkpoint ⇒ same checksum.
+        "params_checksum": engine.canary.load_checksum,
     }
     tmp = args.ready_file + ".tmp"
     with open(tmp, "w") as f:

@@ -178,6 +178,11 @@ class _Request:
     # (tile count, stitch/stream seconds — serve/tiled.py), riding the
     # serve.request span event so `analyze tail`/trace-export see them.
     tiled: "dict | None" = None
+    # Numerics-sentinel probe (telemetry/canary.py): rides the real
+    # queue/batch/dispatch path but is excluded from availability/SLO/
+    # tenant accounting (outcome "canary", like "drained") and its
+    # completion is verified against the warm-up reference digest.
+    canary: bool = False
 
 
 class _Join:
@@ -306,6 +311,19 @@ class SingleChipPredictor:
         multi-chip mesh)."""
         return self.device
 
+    def param_tree(self):
+        """``(params, batch_stats)`` live trees, for the numerics
+        sentinel's integrity checksum (telemetry/canary.py)."""
+        return self.params, self.stats
+
+    def reload_params(self, params) -> None:
+        """Replace the live parameter tree. ``run`` passes
+        ``self.params`` on every call, so the swap takes effect on the
+        next dispatch — the corrupt-drill hook rides this."""
+        import jax
+
+        self.params = jax.device_put(params, self.device)
+
 
 class ServingEngine:
     """Serves single-example requests through pre-compiled bucketed
@@ -415,6 +433,22 @@ class ServingEngine:
         retained as the measured A/B baseline (bench.py ``sched_ab``).
     shed_ratio: fraction of a class's queue bound at which a
         DEPRIORITIZED class starts shedding admissions early.
+    canary_interval_s: numerics-sentinel cadence
+        (:mod:`mpi4dl_tpu.telemetry.canary`; docs/OBSERVABILITY.md
+        "Numerics"): every interval a daemon injects the deterministic
+        golden probe through the REAL dispatch path (outcome
+        ``canary`` — excluded from availability/SLO/tenant accounting
+        like ``drained``) and verifies the answer against the per-
+        bucket reference digest recorded at warm-up, then re-audits
+        the :func:`~mpi4dl_tpu.telemetry.canary.params_checksum`
+        against its load-time value. A divergence emits the
+        ``canary.failure`` event and fires :attr:`canary` callbacks
+        (the fleet worker fences itself). None (default) still records
+        references + the load checksum — :meth:`inject_canary` and
+        :meth:`params_checksum` work on demand — but runs no daemon.
+    canary_seed: probe-derivation seed. Model-level: every replica of
+        one model must share it, or federation cannot compare their
+        canary digests.
     """
 
     def __init__(
@@ -450,6 +484,8 @@ class ServingEngine:
         shed_ratio: float = 0.5,
         tenants=None,
         predictor=None,
+        canary_interval_s: "float | None" = None,
+        canary_seed: int = 0,
     ):
         import jax.numpy as jnp
 
@@ -536,6 +572,27 @@ class ServingEngine:
                 events=self._events,
             )
 
+        # Numerics sentinel (telemetry/canary.py): state exists BEFORE
+        # warm-up so the zeros loop below can record each bucket's
+        # golden-probe reference digest right after its first execute.
+        # The probe input derives from MODEL facts only (shape, dtype,
+        # seed) — every replica of one model computes the same canary.
+        self._canary_interval_s = (
+            float(canary_interval_s)
+            if canary_interval_s is not None and float(canary_interval_s) > 0
+            else None
+        )
+        self.canary = telemetry.CanaryState(
+            registry=self.registry,
+            events=self._events,
+            atol=telemetry.CANARY_ATOL,
+            device=str(self._predictor.limit_device()),
+            program=self._predictor.program,
+        )
+        self._canary_x = telemetry.canary_example(
+            self.example_shape, self._np_dtype, seed=canary_seed
+        )
+
         # AOT warm-up: compile every bucket now, then run each once so the
         # first real request pays neither a compile nor a first-exec setup.
         # With the opt-in admission guard, a bucket whose predicted peak
@@ -602,10 +659,38 @@ class ServingEngine:
                 self._predictor.program, bucket=b,
                 warm_s=round(self.warm_latency_s[b], 6),
             )
+            # Golden-probe reference: the canary padded into this bucket,
+            # row 0 of the answer is the ground truth every later sentinel
+            # probe is verified against. Recorded inside the warming
+            # region (predictor per-run stats must not count it) and
+            # annotated into the SAME ledger entry as the executable
+            # fingerprint, so the exact-vs-quantized digest semantics
+            # stay attributable to the binary that produced them.
+            ref_row = np.asarray(
+                self._predictor.run(
+                    self._compiled[b],
+                    pad_batch([self._canary_x], b, self._np_dtype),
+                )
+            )[0]
+            _entry = self.memory_ledger.get(
+                self._predictor.program, bucket=b
+            ) or {}
+            rec = self.canary.record_reference(
+                b, ref_row, fingerprint=_entry.get("fingerprint")
+            )
+            self.memory_ledger.annotate(
+                self._predictor.program, bucket=b,
+                canary_digest=rec["digest"],
+                canary_qdigest=rec["qdigest"],
+            )
         if hasattr(self._predictor, "warming"):
             self._predictor.warming = False
         self.warmup_wall_s = time.perf_counter() - _warmup_t0
         self.assert_warm()
+        # Load-time parameter-integrity baseline: every later checksum
+        # audit (sentinel cadence, /healthz, federation skew comparison)
+        # is judged against this value.
+        self.canary.record_checksum(self.params_checksum(), load=True)
 
         # The continuous scheduler (or the fifo baseline): per-class
         # bounded EDF queues + the batch former. Burn-rate feedback only
@@ -644,6 +729,7 @@ class ServingEngine:
             "served": 0,
             "served_late": 0,
             "drained": 0,
+            "canary": 0,
             "batches": 0,
             "batched_examples": 0,
         }
@@ -706,6 +792,19 @@ class ServingEngine:
             capacity=flight_capacity,
             registry=self.registry,
             directory=flight_dir or telemetry_dir,
+        )
+        # canary.failure forensics join the postmortem ring alongside the
+        # JSONL log (the ring did not exist when CanaryState was built).
+        self.canary.flight = self.flight
+        # The sentinel daemon: one tick = params-checksum audit + one
+        # golden probe through the REAL dispatch path. Created disabled
+        # (None) without an interval; start()/stop() manage its life.
+        self.sentinel: "telemetry.CanarySentinel | None" = (
+            telemetry.CanarySentinel(
+                self._canary_tick, interval_s=self._canary_interval_s
+            )
+            if self._canary_interval_s is not None
+            else None
         )
         self.last_attribution: "dict | None" = None
         self.watchdog: "telemetry.Watchdog | None" = None
@@ -789,6 +888,7 @@ class ServingEngine:
                 self.registry, port=metrics_port,
                 health=self.health.snapshot, debug=self._debugz,
                 alerts=self.slo.state if self.slo is not None else None,
+                numerics=self.canary.view,
             )
             if metrics_port is not None
             else None
@@ -902,12 +1002,18 @@ class ServingEngine:
             target=self._loop, name="mpi4dl-serve-batcher", daemon=True
         )
         self._thread.start()
+        if self.sentinel is not None:
+            self.sentinel.start()
 
     def stop(self, drain: bool = True) -> None:
         """Stop the batcher. ``drain=True`` serves what is already queued
         first; ``drain=False`` fails queued requests immediately with
         :class:`DrainedError` (counted ``outcome="drained"`` — a
         lifecycle event, not an availability-SLO failure)."""
+        # The sentinel stops FIRST: a probe injected into a stopping
+        # engine would only land in the drain/flush path as noise.
+        if self.sentinel is not None:
+            self.sentinel.stop()
         if not drain:
             self._flush_queue("engine stopped before this request was served")
         self._stop_evt.set()
@@ -1105,6 +1211,64 @@ class ServingEngine:
         out = self._predictor.run(self._compiled[b], batch)
         return np.asarray(out)[0]
 
+    # -- numerics sentinel (telemetry/canary.py) ----------------------------
+
+    def params_checksum(self) -> str:
+        """Order-independent content checksum over the predictor's live
+        parameter tree + BN statistics (``pc`` + 16 hex). Deterministic
+        across replicas loading the same checkpoint — the federation's
+        cross-replica integrity comparison and the ``/healthz`` payload
+        both read this."""
+        params, stats = self._predictor.param_tree()
+        return telemetry.params_checksum(params, stats)
+
+    def inject_canary(self) -> "Future | None":
+        """Inject the golden probe through the REAL dispatch path: the
+        same scheduler queue, batch former, executable, and completion
+        loop as client traffic — a corruption anywhere on that path is
+        caught, not just one in the raw forward. The probe is counted
+        ``outcome="canary"`` and excluded from submitted/SLO/tenant/
+        latency accounting. Returns the probe's future, or None when the
+        queue is full (the sentinel records a ``skipped`` verdict and
+        tries again next interval — probe traffic never displaces client
+        work)."""
+        now = time.monotonic()
+        r = _Request(
+            x=self._canary_x,
+            submit_t=now,
+            # Generous deadline: a canary expiring in a deep queue is a
+            # capacity fact, not a numerics fact — skip, don't diverge.
+            deadline=now + max(30.0, self._default_deadline_s),
+            future=Future(),
+            trace_id=telemetry.new_trace_id("canary"),
+            slo_class=self._sched.resolve(None).name,
+            canary=True,
+        )
+        if self.watchdog is not None:
+            self.watchdog.begin()
+        try:
+            self._sched.put_many([r])
+        except SchedulerFull:
+            if self.watchdog is not None:
+                self.watchdog.cancel()
+            self.canary.skip("queue full")
+            return None
+        return r.future
+
+    def _canary_tick(self) -> None:
+        """One sentinel interval: re-audit the params checksum against
+        its load-time baseline, then send one golden probe (verified
+        against its bucket reference in :meth:`_complete`)."""
+        self.canary.record_checksum(self.params_checksum())
+        self.inject_canary()
+
+    def corrupt_params(self, bits: int = 3, seed: int = 0) -> dict:
+        """Chaos hook (``corrupt:`` drill): flip ``bits`` mantissa-region
+        bits in the predictor's largest parameter leaf WITHOUT updating
+        the canary references or checksum baseline — the sentinel must
+        *discover* the damage. Returns bit-flip forensics."""
+        return telemetry.corrupt_params(self._predictor, bits=bits, seed=seed)
+
     def stats(self) -> dict:
         """Counter snapshot + served-latency percentiles (seconds), plus
         the live queue depth and per-bucket dispatch counts the autoscaling
@@ -1129,6 +1293,7 @@ class ServingEngine:
         out["warmup"] = self.warmup_stats()
         out["healthy"] = self.health.healthy
         out["memory"] = self.memory_view()
+        out["numerics"] = self.canary.view()
         run_stats = getattr(self._predictor, "run_stats", None)
         if run_stats is not None:
             # Tiled predictor: geometry + per-request tile/stitch facts
@@ -1480,6 +1645,24 @@ class ServingEngine:
         for i, r in enumerate(reqs):
             if self.watchdog is not None:
                 self.watchdog.done(now - r.submit_t)
+            if r.canary:
+                # Sentinel probe: verify row i against the bucket's
+                # warm-up reference (row outputs are independent of the
+                # other rows in the batch — the row-bitwise identity the
+                # padding contract already guarantees) and step off the
+                # client accounting entirely: no latency histogram, no
+                # SLO burn, no tenant charge, no span.
+                with self._lock:
+                    self._counts["canary"] += 1
+                self._m_requests.inc(outcome="canary")
+                _entry = self.memory_ledger.get(
+                    self._predictor.program, bucket=bucket
+                ) or {}
+                self.canary.verify(
+                    bucket, logits[i], fingerprint=_entry.get("fingerprint")
+                )
+                r.future.set_result(np.array(logits[i]))
+                continue
             # Cross-process trace surface: the caller (loadgen today, the
             # fleet router tomorrow) reads these off the future to compute
             # its hop overhead and to join its own span segment. Join
@@ -1573,6 +1756,16 @@ class ServingEngine:
                 self._events.write(ev)
 
     def _reject_deadline(self, req: _Request) -> None:
+        if req.canary:
+            # A probe expiring in a deep queue is a capacity fact, not a
+            # numerics verdict — record it skipped, off the client books.
+            if self.watchdog is not None:
+                self.watchdog.done()
+            self.canary.skip("expired in queue")
+            req.future.set_exception(DeadlineExceededError(
+                "canary probe expired while queued"
+            ))
+            return
         with self._lock:
             self._counts["rejected_deadline"] += 1
         self._m_requests.inc(outcome="rejected_deadline")
@@ -1617,6 +1810,11 @@ class ServingEngine:
         for req in self._sched.drain():
             if self.watchdog is not None:
                 self.watchdog.cancel()
+            if req.canary:
+                # Probes never count as drained client work.
+                self.canary.skip("flushed at stop")
+                req.future.set_exception(DrainedError(msg))
+                continue
             if outcome == "drained":
                 with self._lock:
                     self._counts["drained"] += 1

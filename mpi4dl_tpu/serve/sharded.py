@@ -249,6 +249,22 @@ class ShardedPredictor:
         PER-CHIP footprint share against a single chip's limit."""
         return self.trainer.mesh.devices.flat[0]
 
+    def param_tree(self):
+        """``(params, batch_stats)`` live trees, for the numerics
+        sentinel's integrity checksum (telemetry/canary.py)."""
+        return self.params, self.stats
+
+    def reload_params(self, params) -> None:
+        """Replace the live parameter tree (replicated across the mesh,
+        like construction). ``run`` passes ``self.params`` on every
+        call, so the swap takes effect on the next dispatch."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.params = jax.device_put(
+            params, NamedSharding(self.trainer.mesh, P())
+        )
+
 
 def sharded_engine(
     cells: Sequence[Any],

@@ -531,6 +531,27 @@ class TiledPredictor:
     def limit_device(self):
         return self.device
 
+    def param_tree(self):
+        """``(params, batch_stats)`` live trees, for the numerics
+        sentinel's integrity checksum (telemetry/canary.py). Rejoins
+        the section/head split in cell order, so the checksum matches a
+        single-chip replica of the same checkpoint."""
+        return (
+            list(self._p_sec) + list(self._p_head),
+            list(self._s_sec) + list(self._s_head),
+        )
+
+    def reload_params(self, params) -> None:
+        """Replace the live parameter lists, re-split at the geometry
+        boundary. The tile/head executables take params as call
+        arguments (not closure captures), so the swap takes effect on
+        the next dispatch."""
+        import jax
+
+        split = self.geometry.split
+        self._p_sec = jax.device_put(list(params[:split]), self.device)
+        self._p_head = jax.device_put(list(params[split:]), self.device)
+
     # -- the tile-streaming hot loop ------------------------------------------
 
     def _run_one(self, handle: _TiledExecutable, img: np.ndarray):

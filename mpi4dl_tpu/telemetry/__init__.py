@@ -36,7 +36,12 @@ signals from. Four pieces:
 - :mod:`.tail` — slow-request capture: requests past
   max(SLO threshold, K × rolling p99) become rate-limited
   ``tail.sample`` events joining histogram exemplars to full span
-  forensics (``python -m mpi4dl_tpu.analyze tail``).
+  forensics (``python -m mpi4dl_tpu.analyze tail``);
+- :mod:`.canary` — the numerics sentinel: deterministic golden probes
+  re-verified through the real dispatch path on a daemon cadence,
+  param-tree + BN-stats integrity checksums, and the corruption
+  forensics (``canary.failure`` events) behind the fleet's
+  ``numerics_divergence`` page and corrupt-drill quarantine.
 
 Who publishes what: ``serve.ServingEngine`` (request outcomes, queue
 depth, bucket occupancy, pad waste, latency + lifecycle spans),
@@ -57,6 +62,17 @@ from mpi4dl_tpu.telemetry.alerts import (  # noqa: F401
 from mpi4dl_tpu.telemetry.autoscale import (  # noqa: F401
     AutoscaleConfig,
     Autoscaler,
+)
+from mpi4dl_tpu.telemetry.canary import (  # noqa: F401
+    CANARY_ATOL,
+    CanarySentinel,
+    CanaryState,
+    canary_example,
+    corrupt_params,
+    exact_digest,
+    params_checksum,
+    quantized_digest,
+    ulp_diff,
 )
 from mpi4dl_tpu.telemetry.catalog import (  # noqa: F401
     CATALOG,

@@ -46,7 +46,8 @@ CATALOG: "dict[str, MetricSpec]" = {
         "rejected_queue_full, rejected_quota (tenant token bucket "
         "empty — shed before any queue slot), rejected_deadline, "
         "drained (flushed by a deliberate stop/drain — excluded from "
-        "the availability SLO).",
+        "the availability SLO), canary (a numerics-sentinel probe "
+        "riding the real dispatch path — excluded like drained).",
     ),
     "serve_queue_depth": MetricSpec(
         "gauge", (),
@@ -140,6 +141,21 @@ CATALOG: "dict[str, MetricSpec]" = {
         "(Trainer.halo_shift_count on the sharded predictor; 0 on a "
         "single chip) — the partition-math input of the mesh-derived "
         "hlolint halo-permute window that gates every warmed bucket.",
+    ),
+    "canary_checks_total": MetricSpec(
+        "counter", ("result",),
+        "Numerics-sentinel canary verdicts (telemetry/canary.py): ok "
+        "(exact digest match), tolerance (bitwise differs within the "
+        "documented f32 bound — a changed executable, not corruption), "
+        "divergence (beyond tolerance, or a params-checksum mismatch: "
+        "real corruption — emits canary.failure and fences the "
+        "worker), error (no reference), skipped (queue full).",
+    ),
+    "canary_max_divergence": MetricSpec(
+        "gauge", (),
+        "Largest max-abs divergence any canary check has seen against "
+        "its warm-up reference (0 while every check lands ok/"
+        "tolerance) — the magnitude behind a divergence verdict.",
     ),
     # -- gigapixel tiled inference (mpi4dl_tpu/serve/tiled.py) ---------------
     "tiled_tiles_total": MetricSpec(
@@ -367,6 +383,15 @@ CATALOG: "dict[str, MetricSpec]" = {
         "from the scraped /snapshotz histogram) divided by the fleet "
         "median p99 — 1.0 = typical, >= the straggler factor trips the "
         "replica_straggler advisory page.",
+    ),
+    "fleet_numerics_skew": MetricSpec(
+        "gauge", ("replica",),
+        "Numerics-divergence score per replica: disagreements with the "
+        "fleet majority on params checksum / canary digests plus its "
+        "own self-reported canary failures (federation's numerics "
+        "audit) — 0 = agrees, >= 1 trips the numerics_divergence page "
+        "naming the replica. The straggler pattern applied to "
+        "correctness.",
     ),
     # -- federation (mpi4dl_tpu/telemetry/federation.py) ---------------------
     "federation_replicas": MetricSpec(

@@ -157,6 +157,11 @@ class MetricsServer:
         latest attribution summary).
     alerts: zero-arg callable returning the SLO/alert state payload for
         ``/alertz`` (``SLOEvaluator.state``).
+    numerics: zero-arg callable returning the numerics-sentinel payload
+        (``CanaryState.view``): embedded as the ``numerics`` key of
+        ``/snapshotz``, so the federation's existing snapshot scrape
+        carries the params checksum + canary digests with no extra
+        round trip.
     """
 
     def __init__(
@@ -167,11 +172,13 @@ class MetricsServer:
         health=None,
         debug=None,
         alerts=None,
+        numerics=None,
     ):
         self.registry = registry
         self.health = health
         self.debug = debug
         self.alerts = alerts
+        self.numerics = numerics
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -187,6 +194,8 @@ class MetricsServer:
                 if path == "/snapshotz":
                     snap = metrics_event(server.registry)
                     snap["pid"] = os.getpid()
+                    if server.numerics is not None:
+                        snap["numerics"] = server.numerics()
                     return (200, "application/json",
                             json.dumps(snap).encode())
                 if path == "/healthz" and server.health is not None:

@@ -279,6 +279,97 @@ def replica_skew(
     }
 
 
+def numerics_skew(numerics: "dict[str, dict]") -> dict:
+    """Numerics-divergence scoring over the per-replica ``numerics``
+    payloads the aggregator scraped off ``/snapshotz`` — the straggler
+    pattern applied to CORRECTNESS: score each replica's evidence of
+    serving wrong answers, and name the divergent one.
+
+    Three evidence sources, weighted by how conclusive they are:
+
+    - **self-report** (weight 1.0 each): the replica's own sentinel
+      counted canary ``failures``, its fence latched, or its live
+      params checksum drifted from its load-time baseline — each alone
+      is paging evidence (the sentinel only concludes ``divergence``
+      beyond the documented tolerance).
+    - **checksum vote** (weight 1.0): replicas serving the same model
+      must agree on ``params_checksum``; a replica outvoted by a STRICT
+      majority is corrupt even if its own sentinel hasn't fired yet
+      (e.g. corrupted between ticks). A split with no majority (1v1) is
+      recorded as evidence on both, unscored — two replicas alone
+      cannot out-vote each other.
+    - **canary digest vote**: warm-up reference digests, compared
+      bitwise within one (bucket, executable fingerprint) group (weight
+      1.0 — same binary must agree bit for bit: a minority reference
+      means the replica warmed up ALREADY corrupted) and
+      tolerance-quantized across fingerprints (weight 0.4 — advisory
+      by construction: grid-boundary straddles exist, so a qdigest
+      minority alone must stay below the page threshold of 1.0).
+
+    Returns ``{"score": {replica: s}, "evidence": {replica: [notes]}}``
+    — a score ≥ 1.0 is page-worthy (``numerics_divergence``)."""
+    from collections import Counter
+
+    names = [n for n, d in sorted(numerics.items()) if isinstance(d, dict)]
+    score = {n: 0.0 for n in names}
+    evidence: "dict[str, list]" = {n: [] for n in names}
+
+    for n in names:
+        d = numerics[n]
+        fails = int(d.get("failures") or 0)
+        if fails > 0:
+            score[n] += 1.0
+            evidence[n].append(f"self-reported {fails} canary failure(s)")
+        if d.get("fenced"):
+            score[n] += 1.0
+            evidence[n].append("numerics fence latched")
+        lc, cc = d.get("load_checksum"), d.get("params_checksum")
+        if lc and cc and lc != cc:
+            score[n] += 1.0
+            evidence[n].append(
+                f"params checksum drifted: {cc} (loaded {lc})"
+            )
+
+    def _vote(groups: dict, weight: float, what: str) -> None:
+        for key, members in sorted(groups.items()):
+            members = {n: v for n, v in members.items() if v}
+            if len(members) < 2:
+                continue
+            counts = Counter(members.values())
+            if len(counts) <= 1:
+                continue
+            top, topn = counts.most_common(1)[0]
+            if topn * 2 > len(members):  # strict majority names minority
+                for n, v in sorted(members.items()):
+                    if v != top:
+                        score[n] += weight
+                        evidence[n].append(
+                            f"{what}{key}: {v} vs majority {top}"
+                        )
+            else:  # split fleet: surfaced, never scored
+                for n in sorted(members):
+                    evidence[n].append(
+                        f"{what}{key}: no majority "
+                        f"({dict(sorted(counts.items()))})"
+                    )
+
+    _vote(
+        {"": {n: numerics[n].get("params_checksum") for n in names}},
+        1.0, "checksum",
+    )
+    exact: "dict[tuple, dict]" = {}
+    quant: "dict[str, dict]" = {}
+    for n in names:
+        for b, ref in sorted((numerics[n].get("buckets") or {}).items()):
+            fp = ref.get("fingerprint")
+            if fp:
+                exact.setdefault((b, fp), {})[n] = ref.get("digest")
+            quant.setdefault(b, {})[n] = ref.get("qdigest")
+    _vote(exact, 1.0, "canary digest @bucket,fingerprint ")
+    _vote(quant, 0.4, "canary qdigest @bucket ")
+    return {"score": score, "evidence": evidence}
+
+
 class _MergedMetricView:
     """Read-only metric protocol (``kind`` / ``snapshot_series()`` /
     ``value()`` / ``buckets``) over one merged-snapshot entry, so SLO
@@ -381,6 +472,7 @@ class ReplicaTarget:
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.snapshot: "dict | None" = None
+        self.numerics: "dict | None" = None
         self.pid: "int | None" = None
         self.last_ok_ts: "float | None" = None
         self.last_error: "str | None" = None
@@ -485,6 +577,26 @@ class FederatedAggregator:
         )
         self.last_skew: dict = {}
         self.straggler_transitions: "list[dict]" = []
+        # Numerics-divergence detection (telemetry/canary.py): every
+        # scrape scores each replica's numerics payload — self-reported
+        # canary failures/fence/checksum drift + cross-replica checksum
+        # and canary-digest votes (:func:`numerics_skew`) — publishes
+        # the cataloged ``fleet_numerics_skew{replica=}`` gauge, and a
+        # score ≥ 1.0 trips the ``numerics_divergence`` page naming the
+        # corrupt replica. Stock AlertState, same shape as the
+        # straggler page — the correctness analog of the latency one.
+        self._m_numerics = telemetry.declare(
+            self.registry, "fleet_numerics_skew"
+        )
+        self.numerics_alert = telemetry.AlertState(
+            "numerics_divergence", "page", for_s=0.0
+        )
+        self._m_alert.set(
+            0.0, alert=self.numerics_alert.name,
+            severity=self.numerics_alert.severity,
+        )
+        self.last_numerics: dict = {}
+        self.numerics_transitions: "list[dict]" = []
         for name, url in (replicas or {}).items():
             self.add_replica(name, url)
 
@@ -549,6 +661,7 @@ class FederatedAggregator:
                 ) as resp:
                     payload = json.loads(resp.read().decode())
                 t.snapshot = payload["metrics"]
+                t.numerics = payload.get("numerics")
                 t.pid = payload.get("pid")
                 t.last_ok_ts = now
                 t.last_error = None
@@ -571,6 +684,7 @@ class FederatedAggregator:
         self.conflicts = conflicts
         self._m_replicas.set(up, state="up")
         self._evaluate_straggler(children, now)
+        self._evaluate_numerics(now)
         if self.slo is not None:
             try:
                 self.slo.evaluate_once(now)
@@ -627,6 +741,51 @@ class FederatedAggregator:
         if self._events is not None and getattr(self._events, "enabled", False):
             self._events.write(ev)
 
+    def _evaluate_numerics(self, now: float) -> None:
+        """Cross-replica correctness comparison + the
+        ``numerics_divergence`` page (see :func:`numerics_skew`)."""
+        numerics = {
+            t.name: t.numerics
+            for t in self.replicas()
+            if t.numerics is not None
+        }
+        skew = numerics_skew(numerics)
+        self.last_numerics = skew
+        for name, v in skew["score"].items():
+            self._m_numerics.set(v, replica=name)
+        worst = max(
+            skew["score"], key=lambda n: skew["score"][n], default=None
+        )
+        active = worst is not None and skew["score"][worst] >= 1.0
+        st = self.numerics_alert
+        moved = st.step(active, now)
+        self._m_alert.set(
+            1.0 if st.state == "firing" else 0.0,
+            alert=st.name, severity=st.severity,
+        )
+        if moved is None:
+            return
+        ev = {
+            "ts": time.time(),
+            "kind": "event",
+            "name": "alert.transition",
+            "attrs": {
+                "alert": st.name,
+                "severity": st.severity,
+                "from": moved[0],
+                "to": moved[1],
+                # The page names its suspect: WHICH replica serves (or
+                # would serve) wrong answers, on what evidence.
+                "replica": worst,
+                "score": skew["score"].get(worst) if worst else None,
+                "evidence": skew["evidence"].get(worst) if worst else None,
+            },
+        }
+        self.numerics_transitions.append(ev)
+        del self.numerics_transitions[:-64]
+        if self._events is not None and getattr(self._events, "enabled", False):
+            self._events.write(ev)
+
     # -- surfaces -------------------------------------------------------------
 
     def health_snapshot(self) -> dict:
@@ -646,6 +805,7 @@ class FederatedAggregator:
             "conflicts": list(self.conflicts),
             "interval_s": self.interval_s,
             "straggler": self.straggler_state(),
+            "numerics": self.numerics_state(),
             "slo": self.slo.state() if self.slo is not None else None,
         }
 
@@ -660,6 +820,17 @@ class FederatedAggregator:
             "transitions": list(self.straggler_transitions)[-20:],
         }
 
+    def numerics_state(self) -> dict:
+        return {
+            "score": dict(self.last_numerics.get("score", {})),
+            "evidence": {
+                k: list(v)
+                for k, v in self.last_numerics.get("evidence", {}).items()
+            },
+            "alert": self.numerics_alert.snapshot(),
+            "transitions": list(self.numerics_transitions)[-20:],
+        }
+
     def alertz_state(self) -> dict:
         """The fleet ``/alertz`` payload: the SLO evaluator's state (when
         configured) with the straggler alert folded into the same
@@ -671,13 +842,16 @@ class FederatedAggregator:
                   "phase_attribution": None, "autoscale": None}
         )
         base["alerts"] = list(base.get("alerts", ())) + [
-            self.straggler_alert.snapshot()
+            self.straggler_alert.snapshot(),
+            self.numerics_alert.snapshot(),
         ]
         base["transitions"] = (
             list(base.get("transitions", ()))
             + list(self.straggler_transitions)[-20:]
+            + list(self.numerics_transitions)[-20:]
         )
         base["straggler"] = self.straggler_state()
+        base["numerics"] = self.numerics_state()
         return base
 
     def serve(self, port: int = 0, host: str = "127.0.0.1"):

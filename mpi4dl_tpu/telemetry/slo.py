@@ -74,6 +74,9 @@ class Objective:
     # neither good nor bad. "drained" (a deliberate stop/drain flushing
     # the queue) is the canonical member — a fleet scale-down is a
     # lifecycle event and must not burn the availability budget.
+    # "canary" (the numerics sentinel's synthetic probes) rides the
+    # same exclusion: probe traffic is neither served user work nor a
+    # failure, in either direction.
     ignore_outcomes: tuple = ()
     threshold_s: float = 0.0
     # Series selector for latency objectives over a LABELED histogram:
@@ -106,7 +109,7 @@ def availability_objective(
     target: float,
     metric: str = "serve_requests_total",
     good: "tuple | list" = ("served",),
-    ignore: "tuple | list" = ("drained",),
+    ignore: "tuple | list" = ("drained", "canary"),
     name: str = "availability",
 ) -> Objective:
     return Objective(

@@ -199,6 +199,61 @@ def test_costmodel_artifact_dispatches_pure_json(poison, tmp_path):
     assert "poisoned" not in r.stderr
 
 
+def test_numerics_artifact_dispatches_pure_json(poison, tmp_path):
+    """ISSUE 19 satellite: ``analyze numerics --artifact`` re-gates a
+    committed cross-predictor audit report and summarizes canary.failure
+    events from JSONL logs with jax poisoned — the numerics paper trail
+    stays auditable off a dead machine."""
+    rep = tmp_path / "numerics.json"
+    rep.write_text(json.dumps({"pairs": [
+        {"a": "single_chip", "b": "sharded", "max_abs": 2.5e-6,
+         "max_ulp": 12, "atol": 1e-5, "ok": True},
+        # atol omitted: the re-gate recomputes the composed bound from
+        # the pair names (sharded|tiled = 1e-5 + 5e-6).
+        {"a": "sharded", "b": "tiled", "max_abs": 1.2e-5, "max_ulp": 40},
+    ]}))
+    log = tmp_path / "telemetry.jsonl"
+    log.write_text(json.dumps({
+        "ts": 100.0, "kind": "event", "name": "canary.failure",
+        "attrs": {"check": "params_checksum", "expected": "pcaa",
+                  "got": "pcbb"},
+    }) + "\n")
+    out = tmp_path / "regated.json"
+    r = _run(
+        ["numerics", "--artifact", str(rep), "--artifact", str(log),
+         "--json", str(out)],
+        poison,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "poisoned" not in r.stderr
+    assert "2 pair(s)" in r.stdout
+    assert "canary.failure events: 1 (params_checksum=1)" in r.stdout
+    doc = json.loads(out.read_text())
+    assert doc["ok"] is True
+    assert doc["inputs"] == {"reports": 1, "logs": 1}
+    assert doc["pairs"][1]["atol"] == pytest.approx(1.5e-5)
+    assert len(doc["failures"]) == 1
+
+    # A doctored report cannot vouch for itself: the recorded bound is
+    # re-applied to the recorded max_abs, and a breach exits 1.
+    bad = tmp_path / "breach.json"
+    bad.write_text(json.dumps({"pairs": [
+        {"a": "single_chip", "b": "tiled", "max_abs": 1e-3, "ok": True},
+    ]}))
+    r = _run(["numerics", "--artifact", str(bad)], poison)
+    assert r.returncode == 1
+    assert "BREACH" in r.stdout
+    assert "poisoned" not in r.stderr
+
+    # Empty artifacts are a usage error, not a vacuous pass.
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"pairs": []}))
+    r = _run(["numerics", "--artifact", str(empty)], poison)
+    assert r.returncode == 1
+    assert "no audit pairs" in r.stderr
+    assert "poisoned" not in r.stderr
+
+
 def test_coldstart_dispatches_pure_json(poison, tmp_path):
     """ISSUE 18 satellite: ``analyze coldstart --artifact`` joins ledger
     dumps, elastic.restart JSONL events, and a fleet state report into

@@ -107,6 +107,58 @@ def test_fleet_recovery_series_trended_and_inverted(tmp_path):
     assert by_key["fleet_2replica.recovery_s"]["verdict"] == "regressed"
 
 
+def test_numerics_series_trended_and_inverted(tmp_path):
+    """ISSUE 19 satellite: the numerics extra's detection latency and
+    canary-on throughput overhead become trend series with the
+    regression sign INVERTED — slower corruption-to-fence detection or
+    a grown canary tax is the regression, even when the headline rps
+    holds. Rounds without the extra contribute nothing (absent-not-zero
+    — a round benched before the sentinel existed is not a 0s detect)."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    r = _result(7.0, 0.5)
+    r["extras"]["numerics"] = {
+        "value": 340.0, "detect_s": 0.31, "rps_overhead_pct": 1.2,
+        "detected": True, "canary_interval_s": 0.2,
+    }
+    s = extract_series(r)
+    assert s["numerics"] == 340.0                  # rps: higher is better
+    assert s["numerics.detect_s"] == 0.31
+    assert s["numerics.rps_overhead_pct"] == 1.2
+    assert lower_is_better("numerics.detect_s")
+    assert lower_is_better("numerics.rps_overhead_pct")
+    assert not lower_is_better("numerics")
+
+    # Absent-not-zero: a pre-sentinel round has no numerics keys at all.
+    old = extract_series(_result(7.0, 0.5))
+    assert not any(k.startswith("numerics") for k in old)
+    # An undetected corruption run records no detect_s rather than 0.0
+    # (a vanishing detection latency must never read as an improvement).
+    r2 = _result(7.0, 0.5)
+    r2["extras"]["numerics"] = {"value": 340.0, "detected": False,
+                                "rps_overhead_pct": 1.0}
+    s2 = extract_series(r2)
+    assert "numerics.detect_s" not in s2
+    assert s2["numerics.rps_overhead_pct"] == 1.0
+
+    # A slower detection across rounds is CI-visible as a regression.
+    fast, slow = _result(7.0, 0.5), _result(7.0, 0.5)
+    fast["extras"]["numerics"] = {"value": 340.0, "detect_s": 0.3,
+                                  "rps_overhead_pct": 1.0}
+    slow["extras"]["numerics"] = {"value": 340.0, "detect_s": 0.6,
+                                  "rps_overhead_pct": 1.0}
+    paths = _write_rounds(tmp_path, [_round(1, 0, fast),
+                                     _round(2, 0, slow)])
+    assert main(paths) == 1  # 2x detection latency: CI-visible
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [fast, slow]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["numerics.detect_s"]["verdict"] == "regressed"
+
+
 def test_coldstart_phase_series_trended_and_inverted(tmp_path):
     """ISSUE 18 satellite: the coldstart extra's per-arm per-phase
     recovery decomposition becomes ``{name}.phase_s.{arm}.{phase}``

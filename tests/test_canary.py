@@ -1,0 +1,565 @@
+"""Numerics sentinel (:mod:`mpi4dl_tpu.telemetry.canary`) — golden-probe
+derivation, digest semantics, parameter-integrity checksums, the
+CanaryState verdict machine (ok / tolerance / divergence / error /
+skipped), the fleet-side :func:`numerics_skew` scoring goldens, and the
+engine integration: references recorded at warm-up into the footprint
+ledger, a canary riding the REAL dispatch path with ``outcome="canary"``
+off the client books, and ``corrupt_params`` → detection → fence
+callback + schema-valid ``canary.failure`` events.
+
+Determinism note: the integration tests never sleep on the sentinel
+daemon — they call ``inject_canary()`` / ``record_checksum`` directly
+and wait on the returned Future, so verdicts are synchronous facts.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.evaluate import collect_batch_stats
+from mpi4dl_tpu.models.resnet import get_resnet_v2
+from mpi4dl_tpu.parallel.partition import init_cells
+from mpi4dl_tpu.serve import ServingEngine
+from mpi4dl_tpu.telemetry.canary import (
+    CANARY_ATOL,
+    CanarySentinel,
+    CanaryState,
+    canary_example,
+    corrupt_params,
+    exact_digest,
+    flip_bits,
+    params_checksum,
+    quantized_digest,
+    ulp_diff,
+)
+from mpi4dl_tpu.telemetry.federation import numerics_skew
+from mpi4dl_tpu.telemetry.slo import availability_objective
+from mpi4dl_tpu.utils import get_depth
+
+SIZE = 16
+
+
+# -- probe derivation ---------------------------------------------------------
+
+
+def test_canary_example_deterministic_and_fact_sensitive():
+    a = canary_example((SIZE, SIZE, 3), "float32", seed=0)
+    b = canary_example((SIZE, SIZE, 3), "float32", seed=0)
+    assert a.dtype == np.float32 and a.shape == (SIZE, SIZE, 3)
+    np.testing.assert_array_equal(a, b)
+    # Model-level facts each re-derive the probe; nothing else does.
+    assert not np.array_equal(a, canary_example((SIZE, SIZE, 3), seed=1))
+    assert canary_example((8, 8, 3)).shape == (8, 8, 3)
+    assert not np.array_equal(
+        a[:8, :8], canary_example((8, 8, 3))[:8, :8]
+    )
+
+
+# -- digests ------------------------------------------------------------------
+
+
+def test_digest_semantics_exact_vs_quantized():
+    # Values parked a quarter-cell off the quantization grid, so a tiny
+    # perturbation cannot straddle a cell boundary by coincidence.
+    arr = ((np.arange(12, dtype=np.float64) + 0.25) * 2 * CANARY_ATOL).astype(
+        np.float32
+    )
+    d, q = exact_digest(arr), quantized_digest(arr)
+    assert d.startswith("xd") and len(d) == 18
+    assert q.startswith("xq") and len(q) == 18
+    assert exact_digest(arr.copy()) == d
+    assert quantized_digest(arr.copy()) == q
+
+    # Below-tolerance noise: exact digest (bitwise) moves, quantized
+    # (the cross-executable comparison) does not.
+    near = arr.copy()
+    near[3] += 1e-9
+    assert exact_digest(near) != d
+    assert quantized_digest(near) == q
+
+    # Beyond tolerance: both move.
+    far = arr.copy()
+    far[3] += 1e-3
+    assert exact_digest(far) != d
+    assert quantized_digest(far) != q
+
+    # Shape is part of the digest material.
+    assert exact_digest(arr.reshape(3, 4)) != d
+
+
+def test_ulp_diff_counts_representable_floats():
+    a = np.ones(5, np.float32)
+    assert ulp_diff(a, a) == 0
+    b = a.copy()
+    b[2] = np.nextafter(np.float32(1.0), np.float32(2.0))
+    assert ulp_diff(a, b) == 1
+    # Monotone in the perturbation, and symmetric.
+    c = a.copy()
+    c[2] = np.float32(1.0 + 1e-3)
+    assert ulp_diff(a, c) > ulp_diff(a, b)
+    assert ulp_diff(c, a) == ulp_diff(a, c)
+    # ±0.0 are the same point on the monotone integer line.
+    assert ulp_diff(np.float32([-0.0]), np.float32([0.0])) == 0
+    assert ulp_diff(np.float32([]), np.float32([])) == 0
+
+
+# -- parameter integrity ------------------------------------------------------
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "conv": {"w": rng.standard_normal((4, 4)).astype(np.float32)},
+        "dense": [rng.standard_normal(8).astype(np.float32)],
+    }
+
+
+def test_params_checksum_deterministic_and_bit_sensitive():
+    params = _tree()
+    stats = {"bn": np.ones(3, np.float32)}
+    c = params_checksum(params, stats)
+    assert c.startswith("pc") and len(c) == 18
+    # Dict insertion order is not checksum material (sorted traversal).
+    reordered = {"dense": params["dense"], "conv": params["conv"]}
+    assert params_checksum(reordered, stats) == c
+    # BN stats are covered too.
+    assert params_checksum(params, None) != c
+    # One flipped bit in one leaf moves it.
+    mutated = {
+        "conv": {"w": params["conv"]["w"].copy()},
+        "dense": params["dense"],
+    }
+    flat = mutated["conv"]["w"].reshape(-1)
+    flat.view(np.int32)[5] ^= np.int32(1)
+    assert params_checksum(mutated, stats) != c
+
+
+def test_flip_bits_targets_distinct_elements_and_is_involutive():
+    arr = np.linspace(0.5, 2.0, 32).astype(np.float32)
+    out, forensics = flip_bits(arr, bits=3, seed=7)
+    assert forensics["bits"] == 3
+    assert len(set(forensics["indices"])) == 3
+    # Original untouched; exactly the named elements changed.
+    assert np.array_equal(arr, np.linspace(0.5, 2.0, 32).astype(np.float32))
+    changed = np.flatnonzero(out != arr)
+    assert sorted(changed.tolist()) == sorted(forensics["indices"])
+    assert forensics["before"] != forensics["after"]
+    # XOR of bit 30 is an involution: a second flip restores bitwise.
+    back, _ = flip_bits(out, bits=3, seed=7)
+    np.testing.assert_array_equal(back, arr)
+    # bits clamps to the buffer size (and to at least one element).
+    _, f = flip_bits(np.ones(2, np.float32), bits=99, seed=0)
+    assert f["bits"] == 2
+
+
+class _FakePredictor:
+    """param_tree/reload_params contract double for corrupt_params."""
+
+    def __init__(self):
+        rng = np.random.default_rng(3)
+        self.params = {
+            "big": rng.standard_normal(64).astype(np.float32),
+            "small": rng.standard_normal(4).astype(np.float32),
+            "ints": np.arange(4, dtype=np.int32),
+        }
+        self.stats = {"bn": np.ones(2, np.float32)}
+        self.reloaded = None
+
+    def param_tree(self):
+        return self.params, self.stats
+
+    def reload_params(self, params):
+        self.reloaded = params
+
+
+def test_corrupt_params_hits_largest_f32_leaf_via_reload():
+    pred = _FakePredictor()
+    forensics = corrupt_params(pred, bits=2, seed=1)
+    assert forensics["leaf"] == "/big"
+    assert forensics["leaf_size"] == 64
+    assert forensics["bits"] == 2
+    assert pred.reloaded is not None
+    # Only the named leaf changed; the rest of the tree rode through.
+    assert not np.array_equal(pred.reloaded["big"], pred.params["big"])
+    np.testing.assert_array_equal(pred.reloaded["small"], pred.params["small"])
+    np.testing.assert_array_equal(pred.reloaded["ints"], pred.params["ints"])
+    # The live buffers were swapped, not mutated in place — and the
+    # checksum baseline was deliberately NOT updated (the sentinel must
+    # discover the corruption, not be told about it).
+    assert params_checksum(pred.reloaded, pred.stats) != params_checksum(
+        pred.params, pred.stats
+    )
+
+
+# -- CanaryState verdicts -----------------------------------------------------
+
+
+class _Sink:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def write(self, ev):
+        self.events.append(ev)
+
+    record = write  # flight-ring protocol
+
+
+def _state(**kw):
+    kw.setdefault("events", _Sink())
+    kw.setdefault("flight", _Sink())
+    kw.setdefault("device", "cpu:0")
+    kw.setdefault("program", "serve_predict")
+    return CanaryState(**kw)
+
+
+def _ref_row():
+    return ((np.arange(10, dtype=np.float64) + 0.25) * 2 * CANARY_ATOL).astype(
+        np.float32
+    )
+
+
+def test_canary_state_verdict_machine():
+    st = _state(registry=telemetry.MetricsRegistry())
+    fired = []
+    st.on_failure(lambda attrs: 1 / 0)  # a dead fence callback...
+    st.on_failure(fired.append)  # ...must not stop the next one
+
+    rec = st.record_reference(4, _ref_row(), fingerprint="fp-a")
+    assert rec["digest"].startswith("xd")
+    assert rec["qdigest"].startswith("xq")
+
+    # ok: bitwise match inside one executable fingerprint.
+    v = st.verify(4, _ref_row(), fingerprint="fp-a")
+    assert v["result"] == "ok" and st.failures == 0
+
+    # tolerance: bitwise differs, within the documented f32 bound —
+    # a recompiled executable, not corruption.
+    near = _ref_row()
+    near[0] += 1e-6
+    v = st.verify(4, near, fingerprint="fp-b")
+    assert v["result"] == "tolerance"
+    assert v["ulp"] >= 1 and v["max_abs"] <= CANARY_ATOL
+    assert st.failures == 0 and not fired
+
+    # divergence: beyond tolerance — event + fence callbacks.
+    far = _ref_row()
+    far[1] += 1e-2
+    v = st.verify(4, far, fingerprint="fp-a")
+    assert v["result"] == "divergence"
+    assert v["max_abs"] == pytest.approx(1e-2, rel=1e-3)
+    assert st.failures == 1
+    assert st.max_divergence == pytest.approx(1e-2, rel=1e-3)
+    assert fired and fired[-1]["check"] == "probe"
+    assert fired[-1]["expected_digest"] != fired[-1]["got_digest"]
+
+    # error: no reference for the bucket — a verify bug, not a verdict.
+    assert st.verify(8, _ref_row())["result"] == "error"
+
+    # skipped: a canary round that could not run.
+    st.skip("queue full")
+    assert st.last == {
+        "result": "skipped", "reason": "queue full", "ts": st.last["ts"],
+    }
+
+    # Every verdict burned a cataloged counter sample.
+    checks = st._m_checks
+    for result in ("ok", "tolerance", "divergence", "error", "skipped"):
+        assert checks.value(result=result) == 1.0, result
+    assert st._m_divergence.value() == pytest.approx(1e-2, rel=1e-3)
+
+    # The failure event is schema-valid and landed in BOTH sinks.
+    for sink in (st.events, st.flight):
+        evs = [e for e in sink.events if e["name"] == "canary.failure"]
+        assert len(evs) == 1
+        telemetry.validate_event(evs[0])
+        assert evs[0]["attrs"]["bucket"] == 4
+        assert evs[0]["attrs"]["program"] == "serve_predict"
+
+    view = st.view()
+    assert view["checks"] == 4  # ok, tolerance, divergence, error
+    assert view["failures"] == 1
+    assert view["buckets"]["4"]["fingerprint"] == "fp-a"
+    assert "row" not in view["buckets"]["4"]  # arrays stripped
+
+
+def test_canary_state_checksum_drift_is_a_divergence():
+    st = _state()
+    fired = []
+    st.on_failure(fired.append)
+    assert st.record_checksum("pcaaaa", load=True)
+    assert st.load_checksum == "pcaaaa"
+    assert st.record_checksum("pcaaaa")  # steady state: never moves
+    assert st.failures == 0
+    assert not st.record_checksum("pcbbbb")  # torn restore / bit-flip
+    assert st.failures == 1
+    assert fired[-1]["check"] == "params_checksum"
+    assert fired[-1]["expected"] == "pcaaaa"
+    assert fired[-1]["got"] == "pcbbbb"
+    assert st.view()["params_checksum"] == "pcbbbb"
+    assert st.view()["load_checksum"] == "pcaaaa"
+    # First record without load= also becomes the baseline.
+    st2 = _state()
+    assert st2.record_checksum("pccccc")
+    assert st2.load_checksum == "pccccc"
+
+
+def test_canary_sentinel_cadence_and_crash_isolation():
+    ticks = []
+
+    def tick():
+        ticks.append(time.time())
+        if len(ticks) == 1:
+            raise RuntimeError("one bad tick must not kill the daemon")
+
+    s = CanarySentinel(tick, interval_s=0.01, name="t")
+    s.start()
+    deadline = time.time() + 5.0
+    while len(ticks) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    s.stop()
+    assert len(ticks) >= 3
+    assert s.ticks >= 2  # .ticks counts completed ticks; #1 raised
+    n = len(ticks)
+    time.sleep(0.05)
+    assert len(ticks) == n  # stopped means stopped
+
+
+# -- federation scoring goldens ----------------------------------------------
+
+
+def _replica(checksum="pcaaaa", failures=0, fenced=False, load=None,
+             qdigest="xq1", digest="xd1", fp="fp-a"):
+    return {
+        "failures": failures,
+        "fenced": fenced,
+        "params_checksum": checksum,
+        "load_checksum": load if load is not None else checksum,
+        "buckets": {"4": {"digest": digest, "qdigest": qdigest,
+                          "fingerprint": fp}},
+    }
+
+
+def test_numerics_skew_healthy_fleet_scores_zero():
+    out = numerics_skew({"r0": _replica(), "r1": _replica()})
+    assert out["score"] == {"r0": 0.0, "r1": 0.0}
+    assert out["evidence"] == {"r0": [], "r1": []}
+
+
+def test_numerics_skew_self_report_is_paging_evidence():
+    out = numerics_skew({
+        "r0": _replica(),
+        "r1": _replica(failures=2, fenced=True, load="pcload"),
+    })
+    # failures + fence + checksum drift: 1.0 each, all on the reporter.
+    assert out["score"]["r1"] == pytest.approx(3.0)
+    assert out["score"]["r0"] == 0.0
+    assert len(out["evidence"]["r1"]) == 3
+
+
+def test_numerics_skew_checksum_majority_outvotes_silent_corruption():
+    out = numerics_skew({
+        "r0": _replica("pcaaaa"),
+        "r1": _replica("pcaaaa"),
+        "r2": _replica("pcbbbb", load="pcbbbb"),  # corrupt since load
+    })
+    assert out["score"]["r2"] == pytest.approx(1.0)
+    assert out["score"]["r0"] == out["score"]["r1"] == 0.0
+    assert any("majority" in e for e in out["evidence"]["r2"])
+
+
+def test_numerics_skew_two_replica_split_is_evidence_not_score():
+    out = numerics_skew({
+        "r0": _replica("pcaaaa"),
+        "r1": _replica("pcbbbb", load="pcbbbb"),
+    })
+    # 1v1: neither can out-vote the other — surfaced, unscored.
+    assert out["score"] == {"r0": 0.0, "r1": 0.0}
+    assert any("no majority" in e for e in out["evidence"]["r0"])
+    assert any("no majority" in e for e in out["evidence"]["r1"])
+
+
+def test_numerics_skew_exact_digest_vote_within_fingerprint():
+    # Same model and params checksums, same executable fingerprint —
+    # but one replica warmed up with a different bitwise reference.
+    out = numerics_skew({
+        "r0": _replica(digest="xd1"),
+        "r1": _replica(digest="xd1"),
+        "r2": _replica(digest="xd9"),
+    })
+    assert out["score"]["r2"] == pytest.approx(1.0)
+    assert out["score"]["r0"] == 0.0
+
+
+def test_numerics_skew_qdigest_minority_is_advisory():
+    # Different fingerprints (no exact-vote group) — the quantized
+    # digest is the only comparison and must stay below the 1.0 page
+    # threshold by itself (grid straddles exist by construction).
+    out = numerics_skew({
+        "r0": _replica(fp="fp-a", qdigest="xq1"),
+        "r1": _replica(fp="fp-b", qdigest="xq1"),
+        "r2": _replica(fp="fp-c", qdigest="xq9"),
+    })
+    assert out["score"]["r2"] == pytest.approx(0.4)
+    assert out["score"]["r2"] < 1.0
+    assert out["score"]["r0"] == 0.0
+
+
+def test_canary_outcome_excluded_from_availability():
+    obj = availability_objective(0.999)
+    assert "canary" in obj.ignore_outcomes
+    assert "drained" in obj.ignore_outcomes
+
+
+# -- engine integration -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cells = get_resnet_v2(
+        depth=get_depth(2, 1), num_classes=10, pool_kernel=SIZE // 4
+    )
+    rng = np.random.default_rng(0)
+    params = init_cells(
+        cells, jax.random.PRNGKey(0), jnp.zeros((1, SIZE, SIZE, 3))
+    )
+    cal = [jnp.asarray(rng.standard_normal((4, SIZE, SIZE, 3)), jnp.float32)]
+    stats = collect_batch_stats(cells, params, cal)
+    return cells, params, stats
+
+
+def _engine(model, **kw):
+    cells, params, stats = model
+    kw.setdefault("example_shape", (SIZE, SIZE, 3))
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("default_deadline_s", 30.0)
+    return ServingEngine(cells, params, stats, **kw)
+
+
+def test_engine_warmup_records_references_and_baseline(model):
+    eng = _engine(model)
+    view = eng.canary.view()
+    # One golden reference per warm bucket, annotated into the SAME
+    # footprint-ledger entry as the executable fingerprint.
+    assert sorted(int(b) for b in view["buckets"]) == [1, 2, 4]
+    for b, ref in view["buckets"].items():
+        assert ref["digest"].startswith("xd")
+        assert ref["qdigest"].startswith("xq")
+        entry = eng.memory_ledger.get(
+            eng._predictor.program, bucket=int(b)
+        )
+        assert entry["canary_digest"] == ref["digest"]
+        assert entry["canary_qdigest"] == ref["qdigest"]
+        assert ref["fingerprint"] == entry.get("fingerprint")
+    # Load-time integrity baseline is live and self-consistent.
+    assert view["load_checksum"] == view["params_checksum"]
+    assert view["params_checksum"] == eng.params_checksum()
+    assert view["params_checksum"].startswith("pc")
+    # The probe derives from model facts only: a second engine over the
+    # same model computes the identical canary input and checksum.
+    np.testing.assert_array_equal(
+        eng._canary_x, canary_example((SIZE, SIZE, 3), "float32", seed=0)
+    )
+
+
+def test_engine_canary_rides_real_dispatch_off_client_books(model):
+    eng = _engine(model)
+    eng.start()
+    try:
+        fut = eng.inject_canary()
+        assert fut is not None
+        row = np.asarray(fut.result(timeout=60))
+        assert row.shape == (10,)
+        view = eng.canary.view()
+        assert view["last"]["result"] == "ok"  # bitwise, same executable
+        assert view["failures"] == 0
+        # Off the client books: outcome "canary", nothing served.
+        s = eng.stats()
+        assert s["canary"] == 1
+        assert s["served"] == 0
+        assert s["submitted"] == 0
+        # Client traffic alongside canaries keeps its own ledger.
+        xs = [np.zeros((SIZE, SIZE, 3), np.float32) for _ in range(3)]
+        for f in [eng.submit(x) for x in xs]:
+            f.result(timeout=60)
+        s = eng.stats()
+        assert s["served"] == 3 and s["canary"] == 1
+        req = telemetry.declare(eng.registry, "serve_requests_total")
+        assert req.value(outcome="canary") == 1.0
+        checks = telemetry.declare(eng.registry, "canary_checks_total")
+        assert checks.value(result="ok") == 1.0
+        # A full sentinel tick = checksum audit + probe; steady state
+        # concludes ok on both with no failure.
+        eng._canary_tick()
+        deadline = time.time() + 30
+        while eng.canary.checks < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.canary.checks >= 2
+        assert eng.canary.failures == 0
+    finally:
+        eng.stop()
+
+
+def test_engine_corruption_detected_fenced_and_logged(model, tmp_path):
+    eng = _engine(model, telemetry_dir=str(tmp_path))
+    fired = []
+    fence = threading.Event()
+
+    def on_failure(attrs):
+        fired.append(attrs)
+        fence.set()
+
+    eng.canary.on_failure(on_failure)
+    eng.start()
+    try:
+        # Healthy probe first: the baseline verdict this drill flips.
+        eng.inject_canary().result(timeout=60)
+        assert eng.canary.view()["last"]["result"] == "ok"
+
+        forensics = eng.corrupt_params(bits=3, seed=1)
+        assert forensics["bits"] == 3 and forensics["leaf"]
+        # Corruption is silent by design: nothing fires until the
+        # sentinel looks.
+        assert not fired
+
+        # Checksum audit discovers the drift...
+        assert not eng.canary.record_checksum(eng.params_checksum())
+        assert fence.is_set()
+        assert fired[0]["check"] == "params_checksum"
+
+        # ...and the probe independently proves wrong ANSWERS, with
+        # max-abs/ulp forensics (an exponent bit-flip in the largest
+        # conv leaf lands far beyond the documented f32 bound).
+        fut = eng.inject_canary()
+        assert fut is not None
+        fut.result(timeout=60)
+        view = eng.canary.view()
+        assert view["last"]["result"] == "divergence"
+        assert view["last"]["check"] == "probe"
+        assert view["last"]["max_abs"] > CANARY_ATOL
+        assert view["last"]["ulp"] > 0
+        assert view["failures"] >= 2
+        assert view["max_divergence"] > CANARY_ATOL
+        assert eng.stats()["numerics"]["failures"] >= 2
+    finally:
+        eng.stop()
+
+    # The paper trail survives in the JSONL log: schema-valid
+    # canary.failure events for BOTH detection paths.
+    evs = []
+    for log in tmp_path.glob("*.jsonl"):
+        evs += [
+            e for e in telemetry.read_events(str(log))
+            if e.get("name") == "canary.failure"
+        ]
+    checks = sorted({e["attrs"]["check"] for e in evs})
+    assert checks == ["params_checksum", "probe"]
+    for e in evs:
+        assert e["attrs"]["program"] == "serve_predict"
+        assert e["attrs"]["load_checksum"] != e["attrs"]["current_checksum"]

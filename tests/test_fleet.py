@@ -98,6 +98,29 @@ def test_chaos_spec_flood_goldens():
         parse_chaos_spec("delay:bulk=3")
 
 
+def test_chaos_spec_corrupt_goldens():
+    """ISSUE 19: the numerics drill joins the grammar —
+    ``corrupt:REPLICA[=BITS][@AT]`` flips exponent bits in a live
+    replica's param buffer (BITS rides the generic =N spec field)."""
+    op = parse_chaos_spec("corrupt:1@2")
+    assert (op.action, op.target, op.seconds, op.at_s) == (
+        "corrupt", 1, 3.0, 2.0  # 3 bits by default
+    )
+    assert op.describe() == "corrupt:r1=3b@+2s"
+    op = parse_chaos_spec("corrupt:1=8@2")
+    assert op.seconds == 8.0
+    assert op.describe() == "corrupt:r1=8b@+2s"
+    # Routers hold no params: corrupt on a router target is a usage
+    # error, same as every other non-kill router action.
+    with pytest.raises(ValueError, match="router"):
+        parse_chaos_spec("corrupt:router")
+    # Zero (or fractional-zero) bits is a spec error, not a no-op drill.
+    with pytest.raises(ValueError, match="at least 1 bit"):
+        parse_chaos_spec("corrupt:1=0.5")
+    with pytest.raises(ValueError):
+        parse_chaos_spec("corrupt:1=0")
+
+
 # -- router recovery journal (ISSUE 12 tentpole) ------------------------------
 
 
@@ -1476,17 +1499,32 @@ def test_fleet_ha_drill_kill_router_mid_flight(tmp_path):
     assert not doubles, f"double-served trace ids: {doubles}"
 
 
-def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
-    """ISSUE acceptance: 2 replicas under closed-loop load, kill -9 one
-    mid-flight. Zero accepted-request loss (every future resolves with a
-    result), no request served twice, the survivor absorbs the requeue,
-    the supervisor restores the fleet to the (federated)
-    autoscale_desired_replicas count, and one requeued request's trace
-    joins client → router → dead replica → survivor."""
-    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+#: The shared drill fleet's worker-side sentinel cadence (seconds) —
+#: the corrupt drill's detection clock.
+CANARY_INTERVAL_S = 0.25
+
+
+@pytest.fixture(scope="module")
+def live_fleet(tmp_path_factory):
+    """One real 2-replica fleet shared by the corrupt and kill drills —
+    a full spawn + warm-up costs real seconds of the tier-1 budget on
+    the shared CPU box, and the two drills exercise disjoint failure
+    paths on the same topology. Workers run the numerics sentinel hot
+    (``--canary-interval 0.25``) so corruption is detected within one
+    interval; the kill drill is indifferent to canaries (outcome
+    ``canary`` never touches a client book). ``reconcile_interval_s``
+    is a shade slower than the plain kill drill used to run so the
+    fence → quarantine window stays observable to a fast scraper.
+
+    The kill drill — the LAST test in this file — calls ``close()``
+    itself before its flushed-log postmortem; teardown is a guarded
+    no-op after that."""
+    import types
+
     from mpi4dl_tpu.telemetry.autoscale import AutoscaleConfig
 
-    tele = str(tmp_path / "tele")
+    base = tmp_path_factory.mktemp("fleet_drills")
+    tele = str(base / "tele")
     env = dict(
         os.environ,
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -1499,7 +1537,8 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
     )
     sup = FleetSupervisor(
         ["--image-size", "16", "--max-batch", "2",
-         "--telemetry-dir", tele],
+         "--telemetry-dir", tele,
+         "--canary-interval", str(CANARY_INTERVAL_S)],
         router=router,
         replicas=2, max_replicas=2,
         federation=telemetry.SLOConfig(
@@ -1507,16 +1546,210 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
             autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
         ),
         env=env,
-        base_dir=str(tmp_path / "fleet"),
-        reconcile_interval_s=0.1,
+        base_dir=str(base / "fleet"),
+        reconcile_interval_s=0.25,
         heartbeat_timeout_s=5.0,
         backoff_base_s=0.1, backoff_max_s=0.5,
         spawn_timeout_s=420.0,
     )
-    n_requests = 400
+    closed = []
+
+    def close():
+        if closed:  # guard: the kill drill closes early for its
+            return  # postmortem; double Router.stop() is not safe
+        closed.append(True)
+        sup.close()
+        router.stop(drain=False)
+
+    fleet = types.SimpleNamespace(router=router, sup=sup, tele=tele,
+                                  close=close)
     try:
         sup.start()
         sup.wait_ready(timeout_s=420)
+        yield fleet
+    finally:
+        close()
+
+
+def test_fleet_corrupt_drill_detect_page_quarantine(live_fleet):
+    """ISSUE 19 acceptance (the numerics drill): flip exponent bits in
+    one live replica's param buffer through the real chaos plumbing
+    (``corrupt:1`` → /chaos → ``corrupt_params``) while 300 client
+    futures are in flight. The victim's own sentinel detects within
+    ~one canary interval (a schema-valid ``canary.failure`` on its
+    JSONL log), the federation page names it (``numerics_divergence``
+    firing on /alertz with r1's evidence), the supervisor quarantines
+    it (drain → kill → respawn under ``reason="numerics"``), and the
+    survivor keeps every client whole: 300/300 resolve, zero errors,
+    zero deadline misses."""
+    import urllib.request
+
+    from mpi4dl_tpu.fleet.chaos import inject, parse_chaos_spec
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.telemetry.federation import FederatedAggregator
+
+    router, sup, tele = live_fleet.router, live_fleet.sup, live_fleet.tele
+
+    # Fleet-side view: our own aggregator on a hot scrape loop. The
+    # fence → kill window is about one reconcile tick, so a slow
+    # scraper could miss the live fenced payload entirely — and a
+    # failed scrape keeps the replica's LAST snapshot, so one caught
+    # glimpse persists through the victim's dead window.
+    agg = FederatedAggregator(replicas={
+        s.name: f"http://127.0.0.1:{s.ports['metrics_port']}"
+        for s in (sup.slot_by_index(0), sup.slot_by_index(1))
+    })
+    stop_scrape = threading.Event()
+
+    def scrape_loop():
+        while not stop_scrape.is_set():
+            agg.scrape_once()
+            time.sleep(0.02)
+
+    scraper = threading.Thread(target=scrape_loop)
+
+    n_requests = 300
+    base_served = router.stats()["served"]
+    report = {}
+
+    def load():
+        report.update(run_closed_loop(
+            router, n_requests, concurrency=8, deadline_s=120.0,
+            events=router.events,
+        ))
+
+    t = threading.Thread(target=load)
+    try:
+        scraper.start()
+        t.start()
+        # Mid-flight: wait for real traffic, then corrupt r1's live
+        # param buffer while requests are queued on it.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if router.stats()["served"] >= base_served + 60:
+                break
+            time.sleep(0.01)
+        victim_pid = sup.slot_by_index(1).pid
+        t_inject = time.time()
+        record = inject(parse_chaos_spec("corrupt:1"), sup)
+        assert record["applied"] == "corrupt_params"
+        assert record["forensics"]["bits"] == 3  # grammar default
+        assert record["forensics"]["leaf"]
+
+        # The page: r1's self-report (fence latch, canary failures,
+        # checksum drift) crosses the ≥1.0 score threshold and the
+        # transition names the suspect with its evidence.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if agg.numerics_alert.state == "firing":
+                break
+            time.sleep(0.02)
+        assert agg.numerics_alert.state == "firing", agg.last_numerics
+        assert agg.last_numerics["score"].get("r1", 0) >= 1.0
+        firing = [
+            tr for tr in agg.numerics_transitions
+            if tr["attrs"]["to"] == "firing"
+        ]
+        assert firing and firing[0]["attrs"]["replica"] == "r1"
+        assert firing[0]["attrs"]["evidence"]
+        assert agg.registry.get("fleet_numerics_skew").value(
+            replica="r1"
+        ) >= 1.0
+        srv = agg.serve(port=0)
+        alertz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/alertz", timeout=10
+        ).read())
+        assert any(
+            a["name"] == "numerics_divergence" and a["state"] == "firing"
+            for a in alertz["alerts"]
+        )
+
+        # Quarantine: routers stop pulling, the victim dies, a clean
+        # successor spawns on the same slot under the distinct
+        # reason="numerics" restart label (repeat offenders would trip
+        # the same RestartBreaker as any crash loop).
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            if (
+                sup.running_count() == 2
+                and sup.slot_by_index(1).pid != victim_pid
+            ):
+                break
+            time.sleep(0.2)
+        assert sup.running_count() == 2, sup.state()
+        assert sup.slot_by_index(1).pid != victim_pid
+        assert sup.registry.get("fleet_replica_restarts_total").value(
+            replica="r1", reason="numerics"
+        ) >= 1
+
+        t.join(timeout=300)
+        assert not t.is_alive(), "load run wedged"
+        # The survivor kept every client whole through the quarantine.
+        assert report["served"] == n_requests, report
+        assert report["errors"] == 0 and report["deadline_misses"] == 0
+    finally:
+        stop_scrape.set()
+        scraper.join(timeout=10)
+        agg.close()
+        t.join(timeout=300)
+
+    # Detection latency: the victim's sentinel audits the params
+    # checksum every tick, so the canary.failure lands within ~one
+    # canary interval of the injection (generous slop for the shared
+    # CPU box). Event-kind records flush the writer's whole backlog
+    # immediately, so the paper trail is on disk despite the SIGKILL.
+    fails = [
+        e for e in _drill_events(tele)
+        if e.get("name") == "canary.failure" and e["ts"] >= t_inject - 1
+    ]
+    assert fails, "no canary.failure event on the victim's log"
+    first_ts = min(e["ts"] for e in fails)
+    assert first_ts - t_inject <= CANARY_INTERVAL_S + 10.0
+    assert any(e["attrs"]["check"] == "params_checksum" for e in fails)
+
+    # No post-detection answers from the corrupted replica: in ITS log
+    # (the file holding the canary.failure), nothing was engine-served
+    # past the fence beyond the in-flight residue the worker 503'd.
+    # Best-effort by construction — the SIGKILL truncates the span
+    # tail, but the failure event's forced flush pushed out everything
+    # buffered before the fence.
+    for f in sorted(os.listdir(tele)):
+        if not f.endswith(".jsonl"):
+            continue
+        evs = telemetry.read_events(os.path.join(tele, str(f)))
+        fts = [
+            e["ts"] for e in evs
+            if e.get("name") == "canary.failure" and e["ts"] >= t_inject - 1
+        ]
+        if not fts:
+            continue
+        late = [
+            e for e in evs
+            if e.get("kind") == "span" and e.get("name") == "serve.request"
+            and e["attrs"].get("outcome") == "served"
+            and e["ts"] > min(fts) + 2.0
+        ]
+        assert not late, f"victim served after its fence: {late[:3]}"
+
+
+def test_fleet_chaos_drill_kill_replica_mid_flight(live_fleet):
+    """ISSUE acceptance: 2 replicas under closed-loop load, kill -9 one
+    mid-flight. Zero accepted-request loss (every future resolves with a
+    result), no request served twice, the survivor absorbs the requeue,
+    the supervisor restores the fleet to the (federated)
+    autoscale_desired_replicas count, and one requeued request's trace
+    joins client → router → dead replica → survivor.
+
+    Runs on the shared drill fleet AFTER the corrupt drill, so counter
+    asserts are written against deltas/cumulative values and the log
+    postmortem is bounded to this drill's time window."""
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    router, sup, tele = live_fleet.router, live_fleet.sup, live_fleet.tele
+    n_requests = 400
+    t_floor = time.time()  # postmortem window: this drill only
+    try:
+        base_served = router.stats()["served"]
 
         report = {}
 
@@ -1532,7 +1765,7 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
         # SIGKILL replica 1 while requests are queued and in flight.
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
-            if router.stats()["served"] >= 40:
+            if router.stats()["served"] >= base_served + 40:
                 break
             time.sleep(0.01)
         victim = sup.slot_by_index(1)
@@ -1568,12 +1801,14 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(tmp_path):
             replica="r1", reason="exit"
         ) >= 1
     finally:
-        sup.close()
-        router.stop(drain=False)
+        live_fleet.close()
 
     # Postmortem over the flushed logs (workers SIGTERMed + router
-    # stopped above, so every writer closed/flushed).
-    events = _drill_events(tele)
+    # stopped above, so every writer closed/flushed). Bounded to this
+    # drill's window: the corrupt drill shares the telemetry dir, and
+    # its fence deliberately 503s answers the engine already computed —
+    # those traces are requeued and legally served again elsewhere.
+    events = [e for e in _drill_events(tele) if e["ts"] >= t_floor]
     # No double execution: across every replica's engine log, no trace
     # id was SERVED twice.
     served_by_tid: "dict[str, int]" = {}
