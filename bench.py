@@ -35,7 +35,10 @@ latency + the tile/stitch split (``BENCH_TILED=0`` disables;
 ``BENCH_TILED_PX``/``BENCH_TILED_TILE``/``BENCH_TILED_WALK`` scale it);
 ``numerics`` measures the canary sentinel's ON/OFF rps tax and times a
 live bit-flip corrupt drill's corruption→fence detection latency
-(``BENCH_NUMERICS=0`` disables).
+(``BENCH_NUMERICS=0`` disables); ``incident`` reruns the kill drill under
+the incident engine and scores it — MTTD (page→open), MTTR (open→close),
+and whether the auto-postmortem blames the injected chaos op
+(``BENCH_INCIDENT=0`` disables).
 
 Output protocol (timeout-proof by design): a full JSON result line is
 printed AND FLUSHED the moment the headline measurement lands, and an
@@ -694,6 +697,182 @@ def _measure_fleet() -> dict:
             },
         }
     finally:
+        sup.close()
+        if client is not None:
+            client.close()
+
+
+def _measure_incident() -> dict:
+    """Incident-engine drill extra (docs/OBSERVABILITY.md "Incidents"):
+    the replica kill drill again, but SCORED by the incident engine —
+    a standalone :class:`FederatedAggregator` (0.1 s scrape tick, the
+    stock :class:`IncidentManager` riding its alert surface) watches
+    both replicas while ``chaos.inject("kill:1")`` lands the fault.
+
+    Recorded per ISSUE: ``mttd_s`` (page→incident-open, the open
+    record's MTTA), ``mttr_s`` (open→close), and ``blame_correct`` —
+    whether the auto-postmortem's first cause names the injected chaos
+    op. bench-history trends ``incident.mttd_s`` / ``incident.mttr_s``
+    with the regression sign INVERTED (slower detection or recovery
+    regresses); rounds that never detect/close omit the field
+    (absent-not-zero). Throughput through the fault rides ``value``."""
+    import threading
+
+    from mpi4dl_tpu import telemetry
+    from mpi4dl_tpu.fleet.chaos import inject, parse_chaos_spec
+    from mpi4dl_tpu.fleet.frontdoor import RouterSetClient
+    from mpi4dl_tpu.fleet.supervisor import FleetSupervisor
+    from mpi4dl_tpu.serve.loadgen import run_closed_loop
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tele = tempfile.mkdtemp(prefix="mpi4dl-bench-incident-")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        MPI4DL_TPU_TELEMETRY_DIR=tele,
+    )
+    n_requests = 400
+    events = telemetry.JsonlWriter(tele, filename="fleet-events.jsonl")
+    sup = FleetSupervisor(
+        ["--image-size", "16", "--max-batch", "2"],
+        router=None, registry=_REGISTRY,
+        replicas=2, max_replicas=2, warm_pool=1,
+        routers=2,
+        router_args=["--image-size", "16", "--max-attempts", "4",
+                     "--inflight-per-replica", "4",
+                     "--health-interval", "0.1"],
+        env=env, events=events,
+        reconcile_interval_s=0.1, backoff_base_s=0.1,
+        backoff_max_s=0.5, spawn_timeout_s=420.0,
+    )
+    agg = None
+    client = None
+    try:
+        sup.start()
+        sup.wait_ready(timeout_s=420)
+
+        def serving_urls() -> dict:
+            urls = {}
+            for i in range(3):
+                s = sup.slot_by_index(i)
+                if (s is not None and s.state == "running"
+                        and s.role == "serving" and s.ports
+                        and s.ports.get("metrics_port")):
+                    urls[s.name] = (
+                        f"http://127.0.0.1:{s.ports['metrics_port']}"
+                    )
+            return urls
+
+        # The watcher: its own aggregator so the drill controls target
+        # membership (the supervisor-integrated one deregisters a slot
+        # on confirmed death, which this drill reproduces by hand after
+        # recovery). Shares the fleet's event log so incident lifecycle
+        # events interleave with chaos.injected / elastic.restart.
+        agg = telemetry.FederatedAggregator(
+            replicas=serving_urls(), events=events,
+            interval_s=0.1, timeout_s=0.5,
+        )
+        agg.incidents.telemetry_dir = tele
+        agg.start()
+
+        client = RouterSetClient(
+            sup.router_submit_urls(), example_shape=(16, 16, 3),
+            default_deadline_s=120.0,
+        )
+        rep: dict = {}
+
+        def load():
+            rep.update(run_closed_loop(
+                client, n_requests, concurrency=12, deadline_s=120.0,
+            ))
+
+        t = threading.Thread(target=load, name="incident-drill-load")
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if client.stats()["submitted"] >= n_requests // 10:
+                break
+            time.sleep(0.01)
+        t_kill = time.monotonic()
+        inject(parse_chaos_spec("kill:1"), sup)
+
+        # Detection: injected fault → replica_unreachable page → open.
+        kill_to_open = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if agg.incidents.opened_total > 0:
+                kill_to_open = time.monotonic() - t_kill
+                break
+            time.sleep(0.02)
+        mttd = None
+        inc = agg.incidents.open_incident
+        if inc is not None and isinstance(inc.get("mtta_s"), (int, float)):
+            mttd = inc["mtta_s"]
+        t.join(timeout=300)
+
+        # Recovery: wait for the promotion/backfill, then swap the
+        # scrape set to the post-recovery serving slots — the target
+        # swap the supervisor performs on confirmed death + handshake.
+        # The next clean scrape resolves the page and closes the
+        # incident.
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if sup.running_count() == 2 and serving_urls():
+                break
+            time.sleep(0.1)
+        live = serving_urls()
+        for tgt in list(agg.replicas()):
+            if tgt.name not in live:
+                agg.remove_replica(tgt.name)
+        for name, url in live.items():
+            agg.add_replica(name, url)
+        mttr = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if agg.incidents.closed_total > 0:
+                break
+            time.sleep(0.02)
+        state = agg.incidents.state()
+        pm = (state["closed"] or state["open"] or [None])[-1]
+        if state["closed"]:
+            v = pm["incident"].get("mttr_s")
+            if isinstance(v, (int, float)):
+                mttr = v
+            if mttd is None and isinstance(
+                pm["incident"].get("mtta_s"), (int, float)
+            ):
+                mttd = pm["incident"]["mtta_s"]
+        cause = (pm or {}).get("first_cause") or {}
+        blame_correct = bool(
+            cause.get("event") == "chaos.injected"
+            and str((cause.get("attrs") or {}).get("op", "")).startswith(
+                "kill"
+            )
+        )
+        out = {
+            "value": round(rep.get("throughput_rps", 0.0), 1),
+            "unit": "requests/sec through a chaos kill drill scored by "
+                    "the incident engine",
+            "served": rep.get("served"),
+            "errors": rep.get("errors"),
+            "incidents_opened": agg.incidents.opened_total,
+            "incidents_closed": agg.incidents.closed_total,
+            "blame_correct": blame_correct,
+            "first_cause": cause.get("label"),
+        }
+        # Absent-not-zero: a round that never detected (or never
+        # closed) records NO latency rather than a flattering 0.
+        if mttd is not None:
+            out["mttd_s"] = round(mttd, 3)
+        if kill_to_open is not None:
+            out["kill_to_open_s"] = round(kill_to_open, 3)
+        if mttr is not None:
+            out["mttr_s"] = round(mttr, 3)
+        return out
+    finally:
+        if agg is not None:
+            agg.close()
         sup.close()
         if client is not None:
             client.close()
@@ -1702,6 +1881,13 @@ def main():
     # every phase_s series INVERTED so no single phase regrows silently.
     if os.environ.get("BENCH_COLDSTART", "1") != "0":
         run_extra("coldstart", _measure_coldstart, est_seconds=180.0)
+
+    # Incident-engine drill: the kill drill scored by the incident
+    # manager — MTTD/MTTR + first-cause blame accuracy. bench-history
+    # trends incident.mttd_s / incident.mttr_s INVERTED (slower
+    # detection or recovery is the regression; absent-not-zero).
+    if os.environ.get("BENCH_INCIDENT", "1") != "0":
+        run_extra("incident", _measure_incident, est_seconds=200.0)
 
     # Multi-tenant QoS (tenancy subsystem): noisy-neighbor victim p99
     # ratio + Jain's fairness index under a 10:1 flood, and the

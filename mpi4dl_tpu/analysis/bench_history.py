@@ -211,6 +211,15 @@ def extract_series(result: dict) -> "dict[str, float]":
         ov = entry.get("rps_overhead_pct")
         if isinstance(ov, (int, float)):
             out[f"{name}.rps_overhead_pct"] = float(ov)
+        # Incident-engine drill: page→open and open→close latency, both
+        # INVERTED — a slower-detected or slower-closed incident is the
+        # regression. A round that never detected (or never closed)
+        # omits the field entirely and contributes nothing
+        # (absent-not-zero: no flattering 0 s MTTR).
+        for k in ("mttd_s", "mttr_s"):
+            v = entry.get(k)
+            if isinstance(v, (int, float)):
+                out[f"{name}.{k}"] = float(v)
         # Overlap A/B extras (sp2x2_overlap, serving_sharded): per-arm
         # measured overlap ratio (falling fails), SP train-step time
         # (growing fails), and — serving arms only — per-request p99
@@ -261,11 +270,14 @@ def lower_is_better(key: str) -> bool:
     the flood is lost isolation — while ``fairness_index`` keeps the
     normal direction. The numerics sentinel's ``detect_s``
     (corruption-to-fence latency) and ``rps_overhead_pct`` (canary-on
-    throughput tax) both regress upward."""
+    throughput tax) both regress upward, as do the incident drill's
+    ``mttd_s`` (page→incident-open) and ``mttr_s`` (open→close)."""
     return (
         "peak_hbm_bytes" in key
         or key.endswith(".detect_s")
         or key.endswith(".rps_overhead_pct")
+        or key.endswith(".mttd_s")
+        or key.endswith(".mttr_s")
         or ".recovery_s" in key
         or ".phase_s." in key
         or ".step_time_s" in key

@@ -27,6 +27,11 @@ joins histogram exemplars, span segments, and ``tail.sample`` events to
 answer "why was this request slow" per trace id — phase breakdown vs the
 window p50, dominant phase named, worst-requests table
 (:mod:`mpi4dl_tpu.analysis.tail`);
+``python -m mpi4dl_tpu.analyze incident LOGS... [--incident-id ID]
+[--json|--md]`` reconstructs incident timelines and postmortems —
+lifecycle, causally ordered evidence, named first cause, blast radius —
+from JSONL logs alone, matching the live ``/incidentz`` event for event
+(:mod:`mpi4dl_tpu.analysis.incident`);
 ``python -m mpi4dl_tpu.analyze memory-plan`` predicts peak HBM vs the
 device limit for a requested config — compile-only, nothing executes —
 and bisects the max feasible px/bucket
@@ -203,6 +208,14 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.tail import main as tail_main
 
         return tail_main(argv[1:])
+    if argv and argv[0] == "incident":
+        # Incident reconstruction: rebuild incident.open/update/close
+        # lifecycles, correlated timelines, first causes, and blast
+        # radii from JSONL logs — the offline twin of /incidentz. Pure
+        # JSON — runs on logs from a dead machine.
+        from mpi4dl_tpu.analysis.incident import main as incident_main
+
+        return incident_main(argv[1:])
     if argv and argv[0] == "sp-overlap":
         # SP 2x2 halo/compute overlap A/B (monolithic vs decomposed
         # spatial conv): sets up its own CPU mesh + jax like the lint

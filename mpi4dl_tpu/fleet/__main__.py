@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -313,6 +314,14 @@ def main(argv=None) -> int:
     sup = FleetSupervisor(
         _worker_args(args),
         router=router,
+        # Supervisor-side event sink: elastic.restart + the chaos
+        # self-labels (chaos.injected) land next to the worker logs, so
+        # the incident engine's first-cause table can blame an injected
+        # op by name. Without it the HA shape (router subprocesses, no
+        # in-process router to borrow a writer from) logged nothing.
+        events=telemetry.JsonlWriter(
+            args.telemetry_dir, filename=f"fleet-events-{os.getpid()}.jsonl"
+        ),
         routers=max(args.routers, 0),
         router_args=_router_args(args) if args.routers > 0 else None,
         warm_pool=args.warm_pool,
@@ -324,6 +333,20 @@ def main(argv=None) -> int:
         breaker_window_s=args.breaker_window,
         spawn_timeout_s=args.spawn_timeout,
     )
+    incidents = None
+    if sup.aggregator is not None and sup.aggregator.incidents is not None:
+        # The incident engine's paper trail: lifecycle events land in
+        # the fleet telemetry dir (next to every other signal it
+        # correlates), and the supervisor's flight ring files dumps
+        # under the open incident.
+        incidents = sup.aggregator.incidents
+        incidents.telemetry_dir = args.telemetry_dir
+        incidents.events = telemetry.JsonlWriter(
+            args.telemetry_dir, filename=f"incidents-{os.getpid()}.jsonl"
+        )
+        flight = getattr(sup, "_flight", None)
+        if flight is not None:
+            flight.incident = incidents.open_incident_id
     server = None
     if args.metrics_port is not None:
         registry = (
@@ -337,10 +360,17 @@ def main(argv=None) -> int:
                 "router": router.stats() if router is not None else None,
                 "supervisor": sup.state(),
             },
+            alerts=(
+                sup.aggregator.alertz_state
+                if sup.aggregator is not None else None
+            ),
+            incidents=incidents.state if incidents is not None else None,
         )
         print(
             f"# metrics: http://127.0.0.1:{server.port}/metrics "
-            "(also /snapshotz, /healthz, /debugz)",
+            "(also /snapshotz, /healthz, /debugz"
+            + (", /alertz, /incidentz" if sup.aggregator is not None
+               else "") + ")",
             file=sys.stderr, flush=True,
         )
 
@@ -463,6 +493,23 @@ def main(argv=None) -> int:
             "router": sup.last_router_recovery_s,
         }
         report["promotions"] = sup.promotions
+        if incidents is not None:
+            # The drill's verdict surface: what the incident engine made
+            # of the chaos (full timelines live on /incidentz and in the
+            # incident-*.json postmortems next to the logs).
+            report["incidents"] = {
+                "open": (
+                    incidents.open_incident["id"]
+                    if incidents.open_incident else None
+                ),
+                "opened_total": incidents.opened_total,
+                "closed": [
+                    {"id": r["id"], "opened_by": r["opened_by"],
+                     "mtta_s": r["mtta_s"], "mttr_s": r["mttr_s"],
+                     "members": sorted(r["members"])}
+                    for r in incidents.closed
+                ],
+            }
         if args.chaos and not restored:
             rc = 1
     finally:

@@ -64,6 +64,7 @@ never touch a backend.
 from __future__ import annotations
 
 import dataclasses
+import os
 import re
 import threading
 import time
@@ -191,6 +192,43 @@ def parse_chaos_specs(specs) -> "list[ChaosOp]":
     return [parse_chaos_spec(s) for s in specs or ()]
 
 
+def _note_injected(op: ChaosOp, record: dict, supervisor, pid=None) -> None:
+    """Every injected fault self-labels on the fleet event log + flight
+    ring as a schema-valid ``chaos.injected`` event — the incident
+    engine's first-cause table (and any post-hoc debugger) blames the
+    drill from the log alone, no out-of-band knowledge. ``pid`` is the
+    victim process where the fault landed (the injector for flood).
+    Best-effort: telemetry must never fail an injection."""
+    ev = {
+        "ts": float(record.get("ts") or time.time()),
+        "kind": "event",
+        "name": "chaos.injected",
+        "attrs": {
+            "op": record.get("op") or op.describe(),
+            "action": op.action,
+            "domain": op.domain,
+            "target": (
+                record.get("replica") or record.get("router")
+                or record.get("tenant") or f"r{op.target}"
+            ),
+            "at_s": op.at_s,
+            "pid": pid,
+        },
+    }
+    events = getattr(supervisor, "_events", None)
+    if events is not None and getattr(events, "enabled", False):
+        try:
+            events.write(ev)
+        except Exception:  # noqa: BLE001
+            pass
+    flight = getattr(supervisor, "_flight", None)
+    if flight is not None:
+        try:
+            flight.record(ev)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def inject(op: ChaosOp, supervisor, flood=None) -> dict:
     """Apply one op against a live fleet NOW. ``kill`` goes straight to
     the OS (the point is that the victim gets no say); the soft faults
@@ -198,7 +236,9 @@ def inject(op: ChaosOp, supervisor, flood=None) -> dict:
     targets a front-door router slot instead of a replica; ``flood``
     calls the caller-supplied ``flood(op)`` injector (the fleet CLI
     wires a front-door open-loop burst) and embeds what it returns.
-    Returns a record of what was done (the CLI report embeds it)."""
+    Returns a record of what was done (the CLI report embeds it); the
+    same facts land on the fleet event log + flight ring as a
+    ``chaos.injected`` event."""
     if op.action == "flood":
         if flood is None:
             raise ValueError(
@@ -207,6 +247,7 @@ def inject(op: ChaosOp, supervisor, flood=None) -> dict:
             )
         record = {"op": op.describe(), "tenant": op.tenant,
                   "rps": op.rps, "ts": time.time()}
+        _note_injected(op, record, supervisor, pid=os.getpid())
         record.update(flood(op) or {})
         return record
     if op.domain == "router":
@@ -217,6 +258,7 @@ def inject(op: ChaosOp, supervisor, flood=None) -> dict:
             )
         record = {"op": op.describe(), "router": slot.name,
                   "pid": slot.pid, "ts": time.time()}
+        _note_injected(op, record, supervisor, pid=slot.pid)
         slot.kill_hard()
         return record
     slot = supervisor.slot_by_index(op.target)
@@ -225,6 +267,9 @@ def inject(op: ChaosOp, supervisor, flood=None) -> dict:
             f"chaos target index {op.target} has no live replica"
         )
     record = {"op": op.describe(), "replica": slot.name, "ts": time.time()}
+    # The self-label is written BEFORE the fault lands: the cause must
+    # sit at-or-before its first symptom on the incident timeline.
+    _note_injected(op, record, supervisor, pid=slot.pid)
     if op.action == "kill":
         record["pid"] = slot.pid
         slot.kill_hard()

@@ -664,6 +664,22 @@ def main(argv=None) -> int:
         snap["fenced"] = fence.fenced.is_set()
         return snap
 
+    # Engine-side incident engine: rides the engine's OWN SLO evaluator
+    # (when configured) exactly like the federation manager rides the
+    # aggregator — a single-replica deployment still gets incidents,
+    # and this replica's flight dumps file under the open incident.
+    incidents = None
+    if engine.slo is not None:
+        incidents = telemetry.IncidentManager(
+            engine.slo.state,
+            registry=engine.registry,
+            events=engine.events,
+            flight=engine.flight,
+            source="engine",
+        )
+        engine.flight.incident = incidents.open_incident_id
+        incidents.start(interval_s=0.5)
+
     metrics_server = telemetry.MetricsServer(
         _DelayedRegistry(engine.registry, chaos),
         port=args.metrics_port,
@@ -671,6 +687,7 @@ def main(argv=None) -> int:
         debug=engine._debugz,
         alerts=engine.slo.state if engine.slo is not None else None,
         numerics=numerics_payload,
+        incidents=incidents.state if incidents is not None else None,
     )
     predict_httpd = _predict_server(
         engine, chaos, draining, args.port, tiled_engine=tiled_engine,
@@ -739,6 +756,8 @@ def main(argv=None) -> int:
         tiled_engine.stop(drain=True)
     predict_httpd.shutdown()
     metrics_server.close()
+    if incidents is not None:
+        incidents.close()
     if heartbeat is not None:
         heartbeat.close()
     return 0

@@ -92,6 +92,12 @@ from mpi4dl_tpu.telemetry.federation import (  # noqa: F401
     merge_snapshots,
 )
 from mpi4dl_tpu.telemetry.flight import FlightRecorder  # noqa: F401
+from mpi4dl_tpu.telemetry.incident import (  # noqa: F401
+    IncidentManager,
+    build_postmortem,
+    build_timeline,
+    reconstruct_incidents,
+)
 from mpi4dl_tpu.telemetry.health import (  # noqa: F401
     HealthState,
     Watchdog,

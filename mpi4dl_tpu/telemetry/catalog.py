@@ -263,7 +263,9 @@ CATALOG: "dict[str, MetricSpec]" = {
     "flight_recorder_dumps_total": MetricSpec(
         "counter", ("reason",),
         "Flight-recorder postmortem dumps, by trigger: watchdog, crash, "
-        "sigterm, manual.",
+        "sigterm, manual; incident when the dump fired while an "
+        "incident was open (the marker carries the incident id and the "
+        "original trigger).",
     ),
     # -- SLO engine (mpi4dl_tpu/telemetry/slo.py, alerts.py, autoscale.py) ---
     "slo_error_budget_remaining": MetricSpec(
@@ -392,6 +394,30 @@ CATALOG: "dict[str, MetricSpec]" = {
         "audit) — 0 = agrees, >= 1 trips the numerics_divergence page "
         "naming the replica. The straggler pattern applied to "
         "correctness.",
+    ),
+    # -- incident engine (mpi4dl_tpu/telemetry/incident.py) ------------------
+    "incidents_total": MetricSpec(
+        "counter", ("state",),
+        "Incident lifecycle transitions by the IncidentManager, by "
+        "state: opened (a watched alert reached firing with no incident "
+        "open), closed (every member alert resolved).",
+    ),
+    "incident_open": MetricSpec(
+        "gauge", (),
+        "1 while an incident is currently open on this manager, else 0 "
+        "— the scrapeable twin of /incidentz.",
+    ),
+    "incident_mtta_seconds": MetricSpec(
+        "gauge", (),
+        "Time-to-acknowledge of the most recently OPENED incident: "
+        "first member alert firing -> incident open (one evaluation "
+        "tick when the manager rides the scrape loop).",
+    ),
+    "incident_mttr_seconds": MetricSpec(
+        "gauge", (),
+        "Time-to-resolve of the most recently CLOSED incident: open -> "
+        "all member alerts resolved (the number the incident bench "
+        "extra trends as incident.mttr_s).",
     ),
     # -- federation (mpi4dl_tpu/telemetry/federation.py) ---------------------
     "federation_replicas": MetricSpec(

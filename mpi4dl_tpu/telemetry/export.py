@@ -22,8 +22,10 @@ port discovers the surface instead of guessing paths); with providers
 attached, ``/healthz`` answers 200/503 from a
 :class:`mpi4dl_tpu.telemetry.HealthState` snapshot (the load-balancer /
 uptime probe), ``/debugz`` serves the live diagnostic payload (flight
-recorder tail, watchdog state, latest attribution), and ``/alertz``
-serves the SLO evaluator's alert/burn/budget state. ``HEAD`` mirrors
+recorder tail, watchdog state, latest attribution), ``/alertz``
+serves the SLO evaluator's alert/burn/budget state, and ``/incidentz``
+the incident engine's open/recent incidents (correlated timelines,
+first causes, blast radii). ``HEAD`` mirrors
 ``GET`` status/headers without a body — probes get 200, not 501 — and
 non-GET/HEAD methods get 405.
 """
@@ -157,6 +159,10 @@ class MetricsServer:
         latest attribution summary).
     alerts: zero-arg callable returning the SLO/alert state payload for
         ``/alertz`` (``SLOEvaluator.state``).
+    incidents: zero-arg callable returning the incident-engine payload
+        for ``/incidentz`` (``IncidentManager.state``): open/recent
+        incidents with their correlated timelines, first-cause
+        candidates, and blast radii.
     numerics: zero-arg callable returning the numerics-sentinel payload
         (``CanaryState.view``): embedded as the ``numerics`` key of
         ``/snapshotz``, so the federation's existing snapshot scrape
@@ -173,12 +179,14 @@ class MetricsServer:
         debug=None,
         alerts=None,
         numerics=None,
+        incidents=None,
     ):
         self.registry = registry
         self.health = health
         self.debug = debug
         self.alerts = alerts
         self.numerics = numerics
+        self.incidents = incidents
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -209,6 +217,10 @@ class MetricsServer:
                 if path == "/alertz" and server.alerts is not None:
                     return (200, "application/json",
                             json.dumps(server.alerts(), default=str).encode())
+                if path == "/incidentz" and server.incidents is not None:
+                    return (200, "application/json",
+                            json.dumps(server.incidents(),
+                                       default=str).encode())
                 return (404, "text/plain; charset=utf-8", b"not found\n")
 
             def _respond(self, send_body: bool):
@@ -275,6 +287,11 @@ class MetricsServer:
         if self.alerts is not None:
             lines.append(
                 "  /alertz   SLO + alert state JSON (burn rates, budgets)"
+            )
+        if self.incidents is not None:
+            lines.append(
+                "  /incidentz  incident engine JSON (timelines, first "
+                "cause, blast radius)"
             )
         return "\n".join(lines) + "\n"
 

@@ -523,8 +523,16 @@ class FederatedAggregator:
         ``straggler_factor=None`` disables the alert (the gauge still
         publishes). Replicas with fewer than ``straggler_min_count``
         served observations are not scored.
+    unreachable_after: consecutive failed ``/snapshotz`` scrapes of any
+        replica before the ``replica_unreachable`` page fires (the
+        availability page a killed replica trips). ≥2 so one transient
+        timeout does not page; ``None`` disables the alert.
     events: optional :class:`JsonlWriter` for ``alert.transition``
         events (the straggler page's paper trail).
+    incidents: build the stock :class:`IncidentManager` riding this
+        aggregator's alert surface (default on): every scrape tick also
+        steps the incident lifecycle, and :meth:`serve` exposes
+        ``/incidentz``. ``False`` for an aggregator that only merges.
     clock: injectable for deterministic tests (drives the evaluator's
         snapshot ring too).
     """
@@ -539,7 +547,9 @@ class FederatedAggregator:
         timeout_s: float = 2.0,
         straggler_factor: "float | None" = 4.0,
         straggler_min_count: int = 20,
+        unreachable_after: "int | None" = 2,
         events=None,
+        incidents: bool = True,
         clock=time.monotonic,
         start: bool = False,
     ):
@@ -597,6 +607,22 @@ class FederatedAggregator:
         )
         self.last_numerics: dict = {}
         self.numerics_transitions: "list[dict]" = []
+        # Availability detection: a replica whose /snapshotz scrape has
+        # failed ``unreachable_after`` consecutive rounds is DOWN as far
+        # as the fleet can tell — the page a killed replica trips (and
+        # the one an elastic.restart later explains on the incident
+        # timeline). Stock AlertState, same shape as the other two.
+        self.unreachable_after = (
+            int(unreachable_after) if unreachable_after is not None else None
+        )
+        self.unreachable_alert = telemetry.AlertState(
+            "replica_unreachable", "page", for_s=0.0
+        )
+        self._m_alert.set(
+            0.0, alert=self.unreachable_alert.name,
+            severity=self.unreachable_alert.severity,
+        )
+        self.unreachable_transitions: "list[dict]" = []
         for name, url in (replicas or {}).items():
             self.add_replica(name, url)
 
@@ -619,6 +645,20 @@ class FederatedAggregator:
                     clock=clock,
                     start=False,  # the aggregator's tick drives it
                 )
+
+        # The incident engine rides this aggregator's alert surface by
+        # default: the same scrape tick that moves an alert to firing
+        # opens (or folds into / closes) the incident one line later.
+        self.incidents = None
+        if incidents:
+            from mpi4dl_tpu.telemetry.incident import IncidentManager
+
+            self.incidents = IncidentManager(
+                self.alertz_state,
+                registry=self.registry,
+                events=self._events,
+                source="federation",
+            )
 
         self.server = None
         self._stop_evt = threading.Event()
@@ -685,11 +725,14 @@ class FederatedAggregator:
         self._m_replicas.set(up, state="up")
         self._evaluate_straggler(children, now)
         self._evaluate_numerics(now)
+        self._evaluate_unreachable(now)
         if self.slo is not None:
             try:
                 self.slo.evaluate_once(now)
             except Exception:  # noqa: BLE001 — fleet evaluation is a
                 pass  # sidecar; the scrape loop must survive it
+        if self.incidents is not None:
+            self.incidents.step()
         return merged
 
     def _evaluate_straggler(self, children: dict, now: float) -> None:
@@ -786,6 +829,56 @@ class FederatedAggregator:
         if self._events is not None and getattr(self._events, "enabled", False):
             self._events.write(ev)
 
+    def _evaluate_unreachable(self, now: float) -> None:
+        """The ``replica_unreachable`` availability page: fires while
+        any replica's consecutive failed scrapes reach the threshold;
+        resolves as soon as every configured replica answers again
+        (a respawned successor re-registers its new port on ready)."""
+        if self.unreachable_after is None:
+            return
+        targets = self.replicas()
+        down = sorted(
+            t.name for t in targets
+            if t.consecutive_failures >= self.unreachable_after
+        )
+        worst = max(
+            (t for t in targets if t.name in down),
+            key=lambda t: t.consecutive_failures,
+            default=None,
+        )
+        st = self.unreachable_alert
+        moved = st.step(bool(down), now)
+        self._m_alert.set(
+            1.0 if st.state == "firing" else 0.0,
+            alert=st.name, severity=st.severity,
+        )
+        if moved is None:
+            return
+        ev = {
+            "ts": time.time(),
+            "kind": "event",
+            "name": "alert.transition",
+            "attrs": {
+                "alert": st.name,
+                "severity": st.severity,
+                "from": moved[0],
+                "to": moved[1],
+                # The page names its suspect: WHICH replica stopped
+                # answering, for how many rounds, with the last error.
+                "replica": worst.name if worst else None,
+                "down": down,
+                "consecutive_failures": (
+                    worst.consecutive_failures if worst else None
+                ),
+                "last_error": worst.last_error if worst else None,
+                "threshold": self.unreachable_after,
+            },
+        }
+        self.unreachable_transitions.append(ev)
+        del self.unreachable_transitions[:-64]
+        if self._events is not None and getattr(self._events, "enabled", False):
+            self._events.write(ev)
+
     # -- surfaces -------------------------------------------------------------
 
     def health_snapshot(self) -> dict:
@@ -806,6 +899,7 @@ class FederatedAggregator:
             "interval_s": self.interval_s,
             "straggler": self.straggler_state(),
             "numerics": self.numerics_state(),
+            "unreachable": self.unreachable_state(),
             "slo": self.slo.state() if self.slo is not None else None,
         }
 
@@ -831,6 +925,18 @@ class FederatedAggregator:
             "transitions": list(self.numerics_transitions)[-20:],
         }
 
+    def unreachable_state(self) -> dict:
+        return {
+            "threshold": self.unreachable_after,
+            "down": [
+                t.name for t in self.replicas()
+                if self.unreachable_after is not None
+                and t.consecutive_failures >= self.unreachable_after
+            ],
+            "alert": self.unreachable_alert.snapshot(),
+            "transitions": list(self.unreachable_transitions)[-20:],
+        }
+
     def alertz_state(self) -> dict:
         """The fleet ``/alertz`` payload: the SLO evaluator's state (when
         configured) with the straggler alert folded into the same
@@ -844,14 +950,17 @@ class FederatedAggregator:
         base["alerts"] = list(base.get("alerts", ())) + [
             self.straggler_alert.snapshot(),
             self.numerics_alert.snapshot(),
+            self.unreachable_alert.snapshot(),
         ]
         base["transitions"] = (
             list(base.get("transitions", ()))
             + list(self.straggler_transitions)[-20:]
             + list(self.numerics_transitions)[-20:]
+            + list(self.unreachable_transitions)[-20:]
         )
         base["straggler"] = self.straggler_state()
         base["numerics"] = self.numerics_state()
+        base["unreachable"] = self.unreachable_state()
         return base
 
     def serve(self, port: int = 0, host: str = "127.0.0.1"):
@@ -868,6 +977,9 @@ class FederatedAggregator:
             health=self.health_snapshot,
             debug=self.state,
             alerts=self.alertz_state,
+            incidents=(
+                self.incidents.state if self.incidents is not None else None
+            ),
         )
         return self.server
 
@@ -894,6 +1006,8 @@ class FederatedAggregator:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.incidents is not None:
+            self.incidents.close()
         if self.server is not None:
             self.server.close()
             self.server = None

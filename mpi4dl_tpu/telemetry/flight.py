@@ -40,6 +40,12 @@ class FlightRecorder:
         final at-dump snapshot, and the dump counter.
     directory: where dumps land; falls back to ``MPI4DL_TPU_TELEMETRY_DIR``
         then the system temp dir, resolved at dump time.
+    incident: optional zero-arg callable returning the currently open
+        incident's id (``IncidentManager.open_incident_id``) or None.
+        A dump triggered while an incident is open files under
+        ``reason="incident"`` with the incident id and the original
+        trigger in the dump marker — the incident's ``close`` event
+        links it back.
     """
 
     def __init__(
@@ -48,7 +54,9 @@ class FlightRecorder:
         registry=None,
         directory: "str | None" = None,
         snapshot_interval_s: float = 1.0,
+        incident=None,
     ):
+        self.incident = incident
         self.capacity = int(capacity)
         self._ring: collections.deque = collections.deque(
             maxlen=max(1, self.capacity)
@@ -111,12 +119,27 @@ class FlightRecorder:
                 good.append(validate_event(ev))
             except ValueError:
                 dropped += 1
+        # A dump captured while an incident is open belongs to the
+        # incident: it refiles under reason="incident" carrying the id
+        # (and the original trigger), so the incident's close event can
+        # link every postmortem artifact taken in its window.
+        iid = None
+        if self.incident is not None:
+            try:
+                iid = self.incident()
+            except Exception:  # noqa: BLE001 — a broken provider must
+                iid = None  # not break the postmortem dump
+        marker_attrs = {"reason": reason, "events": len(good),
+                        "dropped_invalid": dropped}
+        if iid:
+            marker_attrs["trigger"] = reason
+            marker_attrs["incident"] = iid
+            marker_attrs["reason"] = reason = "incident"
         good.append(validate_event({
             "ts": time.time(),
             "kind": "event",
             "name": "flight.dump",
-            "attrs": {"reason": reason, "events": len(good),
-                      "dropped_invalid": dropped},
+            "attrs": marker_attrs,
         }))
         if path is None:
             directory = (

@@ -254,6 +254,62 @@ def test_numerics_artifact_dispatches_pure_json(poison, tmp_path):
     assert "poisoned" not in r.stderr
 
 
+def test_incident_dispatches_pure_json(poison, tmp_path):
+    """ISSUE 20 satellite: ``analyze incident`` reconstructs incident
+    timelines + auto-postmortems from JSONL logs with jax poisoned —
+    the on-call hand-off doc renders off a dead machine."""
+    log = tmp_path / "telemetry.jsonl"
+    lines = [
+        {"ts": 99.0, "kind": "event", "name": "chaos.injected",
+         "attrs": {"op": "kill:r1@+1s", "action": "kill", "pid": 42}},
+        {"ts": 99.5, "kind": "event", "name": "alert.transition",
+         "attrs": {"alert": "replica_unreachable", "severity": "page",
+                   "from": "resolved", "to": "firing", "replica": "r1"}},
+        {"ts": 100.0, "kind": "event", "name": "incident.open",
+         "attrs": {"id": "inc-1", "opened_ts": 100.0,
+                   "alert": "replica_unreachable", "severity": "page",
+                   "mtta_s": 0.5, "lookback_s": 30.0,
+                   "members": [{"name": "replica_unreachable",
+                                "severity": "page",
+                                "first_firing_ts": 99.5}]}},
+        {"ts": 103.0, "kind": "event", "name": "incident.close",
+         "attrs": {"id": "inc-1", "closed_ts": 103.0, "mttr_s": 3.0,
+                   "members": [{"name": "replica_unreachable",
+                                "severity": "page",
+                                "resolved_ts": 103.0}]}},
+    ]
+    log.write_text("".join(json.dumps(e) + "\n" for e in lines))
+
+    r = _run(["incident", str(log)], poison)
+    assert r.returncode == 0, r.stderr
+    assert "poisoned" not in r.stderr
+    assert "inc-1" in r.stdout and "closed" in r.stdout
+    assert "injected chaos op kill:r1@+1s" in r.stdout
+    assert "1 incident(s)" in r.stderr
+
+    r = _run(["incident", str(log), "--json"], poison)
+    assert r.returncode == 0, r.stderr
+    (pm,) = json.loads(r.stdout)
+    assert pm["incident"]["id"] == "inc-1"
+    assert pm["incident"]["mttr_s"] == 3.0
+    assert pm["first_cause"]["event"] == "chaos.injected"
+    assert [e["name"] for e in pm["timeline"]] == [
+        "chaos.injected", "alert.transition",
+    ]
+
+    r = _run(["incident", str(log), "--md"], poison)
+    assert r.returncode == 0, r.stderr
+    assert "# Incident inc-1 — closed" in r.stdout
+    assert "## Timeline" in r.stdout
+    assert "poisoned" not in r.stderr
+
+    # An unknown incident id is a usage error, not a vacuous pass.
+    r = _run(["incident", str(log), "--incident-id", "inc-nope"], poison)
+    assert r.returncode == 1
+    assert "no incident" in r.stderr
+    assert "poisoned" not in r.stderr
+
+
 def test_coldstart_dispatches_pure_json(poison, tmp_path):
     """ISSUE 18 satellite: ``analyze coldstart --artifact`` joins ledger
     dumps, elastic.restart JSONL events, and a fleet state report into

@@ -159,6 +159,62 @@ def test_numerics_series_trended_and_inverted(tmp_path):
     assert by_key["numerics.detect_s"]["verdict"] == "regressed"
 
 
+def test_incident_series_trended_and_inverted(tmp_path):
+    """ISSUE 20 satellite: the incident extra's MTTD (page→open) and
+    MTTR (open→close) become trend series with the regression sign
+    INVERTED — a slower-opening or slower-closing incident engine is
+    the regression, even when the headline rps holds. Rounds without
+    the extra contribute nothing, and a drill where the incident never
+    opened (or never closed) records no mttd/mttr rather than 0.0
+    (absent-not-zero: a vanishing time-to-detect must never read as an
+    improvement)."""
+    from mpi4dl_tpu.analysis.bench_history import lower_is_better
+
+    r = _result(7.0, 0.5)
+    r["extras"]["incident"] = {
+        "value": 310.0, "mttd_s": 2.4, "mttr_s": 11.0,
+        "incidents_opened": 1, "incidents_closed": 1,
+        "blame_correct": True,
+    }
+    s = extract_series(r)
+    assert s["incident"] == 310.0                  # rps: higher is better
+    assert s["incident.mttd_s"] == 2.4
+    assert s["incident.mttr_s"] == 11.0
+    assert lower_is_better("incident.mttd_s")
+    assert lower_is_better("incident.mttr_s")
+    assert not lower_is_better("incident")
+
+    # Absent-not-zero: a pre-engine round has no incident keys at all.
+    old = extract_series(_result(7.0, 0.5))
+    assert not any(k.startswith("incident") for k in old)
+    # A drill whose incident never closed records no mttr_s.
+    r2 = _result(7.0, 0.5)
+    r2["extras"]["incident"] = {"value": 310.0, "mttd_s": 2.0,
+                                "incidents_opened": 1,
+                                "incidents_closed": 0}
+    s2 = extract_series(r2)
+    assert s2["incident.mttd_s"] == 2.0
+    assert "incident.mttr_s" not in s2
+
+    # A slower close across rounds is CI-visible as a regression.
+    fast, slow = _result(7.0, 0.5), _result(7.0, 0.5)
+    fast["extras"]["incident"] = {"value": 310.0, "mttd_s": 2.0,
+                                  "mttr_s": 10.0}
+    slow["extras"]["incident"] = {"value": 310.0, "mttd_s": 2.0,
+                                  "mttr_s": 25.0}
+    paths = _write_rounds(tmp_path, [_round(1, 0, fast),
+                                     _round(2, 0, slow)])
+    assert main(paths) == 1  # 2.5x MTTR: CI-visible
+    cmp = compare(
+        [{"path": p, "n": i + 1, "rc": 0, "result": r}
+         for i, (p, r) in enumerate(zip(paths, [fast, slow]))],
+        tolerance=0.05, strict=False,
+    )
+    by_key = {k["key"]: k for k in cmp["keys"]}
+    assert by_key["incident.mttr_s"]["verdict"] == "regressed"
+    assert by_key["incident.mttd_s"]["verdict"] == "flat"
+
+
 def test_coldstart_phase_series_trended_and_inverted(tmp_path):
     """ISSUE 18 satellite: the coldstart extra's per-arm per-phase
     recovery decomposition becomes ``{name}.phase_s.{arm}.{phase}``

@@ -199,6 +199,77 @@ def test_analyze_tail_cli(tmp_path, capsys):
     assert main(["tail", str(log), "--trace-id", "missing"]) == 1
 
 
+def test_analyze_incident_cli_md_timeline_golden(tmp_path, capsys):
+    """ISSUE 20 CI satellite: `python -m mpi4dl_tpu.analyze incident`
+    through the real dispatch — pure JSON, pre-jax. Canned MULTI-PID
+    logs whose file order disagrees with wall-clock order, plus a
+    cause/symptom pair sharing one coarse timestamp: the rendered
+    ``--md`` timeline must come out in causal order regardless."""
+    from mpi4dl_tpu.analysis.cli import main
+
+    # pid-7 log (supervisor side): the chaos op, the restart, and the
+    # incident lifecycle.
+    (tmp_path / "telemetry-7.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in [
+            {"ts": 100.0, "kind": "event", "name": "chaos.injected",
+             "attrs": {"op": "kill:r1@+1s", "action": "kill", "pid": 8}},
+            {"ts": 100.4, "kind": "event", "name": "elastic.restart",
+             "attrs": {"replica": "r1", "reason": "exit"}},
+            {"ts": 100.3, "kind": "event", "name": "incident.open",
+             "attrs": {"id": "inc-7", "opened_ts": 100.3,
+                       "alert": "replica_unreachable", "severity": "page",
+                       "mtta_s": 0.3, "lookback_s": 10.0,
+                       "members": [{"name": "replica_unreachable",
+                                    "severity": "page",
+                                    "first_firing_ts": 100.0}]}},
+            {"ts": 101.5, "kind": "event", "name": "incident.close",
+             "attrs": {"id": "inc-7", "closed_ts": 101.5, "mttr_s": 1.2,
+                       "members": [{"name": "replica_unreachable",
+                                    "severity": "page",
+                                    "resolved_ts": 101.5}]}},
+        ])
+    )
+    # pid-8 log (worker side), listed AFTER pid-7 but carrying EARLIER
+    # wall times — and a page transition tying the chaos op's ts
+    # exactly (coarse clocks do that): the cause must still sort first.
+    (tmp_path / "telemetry-8.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in [
+            {"ts": 100.0, "kind": "event", "name": "alert.transition",
+             "attrs": {"alert": "replica_unreachable", "severity": "page",
+                       "from": "resolved", "to": "firing"}},
+            {"ts": 99.5, "kind": "event", "name": "oom.report",
+             "attrs": {"program": "serve_predict"}},
+        ])
+    )
+
+    assert main(["incident", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "inc-7" in out and "injected chaos op kill:r1@+1s" in out
+
+    assert main(["incident", str(tmp_path), "--md"]) == 0
+    md = capsys.readouterr().out
+    assert "# Incident inc-7 — closed" in md
+    assert "| MTTR | 1.200s |" in md
+    rows = [
+        line.split("`")[1] for line in md.splitlines()
+        if line.startswith("| ") and "`" in line
+        and "| t−open |" not in line
+    ]
+    # Golden causal order: wall time across pids, cause before symptom
+    # at the shared timestamp — NOT file order, NOT emission order.
+    assert rows == [
+        "replica_unreachable",  # opened-by field row
+        "replica_unreachable",  # members field row
+        "oom.report", "chaos.injected", "alert.transition",
+        "elastic.restart",
+    ]
+
+    assert main(["incident", str(tmp_path), "--json"]) == 0
+    (pm,) = json.loads(capsys.readouterr().out)
+    assert [e["ts"] for e in pm["timeline"]] == [99.5, 100.0, 100.0, 100.4]
+    assert main(["incident", str(tmp_path), "--incident-id", "nope"]) == 1
+
+
 def test_fleet_cli_plan_smoke(capsys):
     """ISSUE CI satellite: `python -m mpi4dl_tpu.fleet --plan` — the
     pure-dispatch path: chaos specs parsed + validated, the fleet plan

@@ -121,6 +121,47 @@ def test_chaos_spec_corrupt_goldens():
         parse_chaos_spec("corrupt:1=0")
 
 
+def test_chaos_inject_self_labels_event_log_and_flight(tmp_path):
+    """Satellite golden (ISSUE 20): every injection writes a
+    schema-valid ``chaos.injected`` event to the fleet event log AND
+    the flight ring BEFORE the fault lands — the incident engine's
+    first-cause table blames the drill from the log alone."""
+    import types
+
+    from mpi4dl_tpu.fleet.chaos import inject
+
+    writer = telemetry.JsonlWriter(str(tmp_path))
+    flight = telemetry.FlightRecorder()
+    killed = []
+    slot = types.SimpleNamespace(
+        name="r1", pid=4242, kill_hard=lambda: killed.append(True),
+    )
+    sup = types.SimpleNamespace(
+        slot_by_index=lambda i: slot, _events=writer, _flight=flight,
+    )
+    record = inject(parse_chaos_spec("kill:1"), sup)
+    writer.close()
+    assert killed and record["pid"] == 4242
+    evs = [
+        e for e in telemetry.read_events(writer.path)
+        if e["name"] == "chaos.injected"
+    ]
+    assert len(evs) == 1
+    ev = telemetry.validate_event(evs[0])
+    assert ev["attrs"] == {
+        "op": "kill:r1@+1s", "action": "kill", "domain": "replica",
+        "target": "r1", "at_s": 1.0, "pid": 4242,
+    }
+    assert ev["ts"] == pytest.approx(record["ts"])
+    # The flight ring mirrors the label (a crash dump carries the
+    # cause even if the JSONL writer never flushed).
+    ring = [e for e in flight.tail() if e["name"] == "chaos.injected"]
+    assert len(ring) == 1 and ring[0]["attrs"]["op"] == "kill:r1@+1s"
+    # A broken/missing telemetry surface must never fail an injection.
+    bare = types.SimpleNamespace(slot_by_index=lambda i: slot)
+    assert inject(parse_chaos_spec("kill:1"), bare)["pid"] == 4242
+
+
 # -- router recovery journal (ISSUE 12 tentpole) ------------------------------
 
 
@@ -1598,7 +1639,7 @@ def test_fleet_corrupt_drill_detect_page_quarantine(live_fleet):
     agg = FederatedAggregator(replicas={
         s.name: f"http://127.0.0.1:{s.ports['metrics_port']}"
         for s in (sup.slot_by_index(0), sup.slot_by_index(1))
-    })
+    }, events=telemetry.JsonlWriter(tele, filename="incidents-corrupt.jsonl"))
     stop_scrape = threading.Event()
 
     def scrape_loop():
@@ -1687,6 +1728,86 @@ def test_fleet_corrupt_drill_detect_page_quarantine(live_fleet):
         # The survivor kept every client whole through the quarantine.
         assert report["served"] == n_requests, report
         assert report["errors"] == 0 and report["deadline_misses"] == 0
+
+        # -- incident engine (ISSUE 20): the drill is SCORED. The
+        # numerics page opened exactly ONE incident on this
+        # aggregator's manager; the quarantine kill's availability page
+        # FOLDS into it rather than opening a second one.
+        inc_mgr = agg.incidents
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if inc_mgr.opened_total >= 1:
+                break
+            time.sleep(0.02)
+        assert inc_mgr.opened_total == 1, inc_mgr.state()
+
+        # chaos.injected self-label golden: the injection is on the
+        # fleet event log as a schema-valid event naming op + victim —
+        # the postmortem blames the drill from the log alone.
+        chaos_evs = [
+            e for e in _drill_events(tele)
+            if e.get("name") == "chaos.injected" and e["ts"] >= t_inject - 1
+        ]
+        assert len(chaos_evs) == 1, chaos_evs
+        cev = telemetry.validate_event(chaos_evs[0])
+        assert cev["attrs"]["op"].startswith("corrupt:r1")
+        assert cev["attrs"]["action"] == "corrupt"
+        assert cev["attrs"]["domain"] == "replica"
+        assert cev["attrs"]["target"] == "r1"
+        assert cev["attrs"]["pid"] == victim_pid
+
+        # Close: swap the r1 target to the clean successor (the same
+        # swap the supervisor-integrated aggregator performs on
+        # confirmed death + handshake) — the next clean scrape resolves
+        # both pages and the incident closes.
+        agg.add_replica(
+            "r1",
+            f"http://127.0.0.1:"
+            f"{sup.slot_by_index(1).ports['metrics_port']}",
+        )
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if inc_mgr.closed_total >= 1:
+                break
+            time.sleep(0.02)
+        assert inc_mgr.closed_total == 1, inc_mgr.state()
+        assert inc_mgr.open_incident is None
+        assert inc_mgr.opened_total == 1  # folded, never fragmented
+
+        # /incidentz on the aggregator's MetricsServer: the postmortem
+        # names the injected op as first cause, and the drill's own
+        # page is a member.
+        incidentz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/incidentz", timeout=10
+        ).read())
+        assert incidentz["counts"] == {"opened": 1, "closed": 1}
+        live_pm = incidentz["closed"][-1]
+        assert "numerics_divergence" in live_pm["incident"]["members"]
+        cause = live_pm["first_cause"]
+        assert cause["event"] == "chaos.injected", live_pm["timeline"]
+        assert cause["attrs"]["op"].startswith("corrupt:r1")
+        assert cause["label"] == f"injected chaos op {cev['attrs']['op']}"
+        assert agg.registry.get("incidents_total").value(
+            state="opened"
+        ) == 1
+        assert agg.registry.get("incident_open").value() == 0.0
+
+        # Offline reconstruction (the analyze-incident path) over the
+        # same logs matches the live /incidentz timeline event for
+        # event — closed windows are bounded by closed_ts, so the
+        # still-running fleet cannot skew the comparison.
+        from mpi4dl_tpu.telemetry.incident import (
+            build_postmortem, collect_events, reconstruct_incidents,
+        )
+        events = collect_events([tele])
+        recs = [
+            r for r in reconstruct_incidents(events)
+            if r["id"] == live_pm["incident"]["id"]
+        ]
+        assert len(recs) == 1
+        off_pm = build_postmortem(recs[0], events)
+        assert off_pm["timeline"] == live_pm["timeline"]
+        assert off_pm["first_cause"] == cause
     finally:
         stop_scrape.set()
         scraper.join(timeout=10)
@@ -1742,13 +1863,44 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(live_fleet):
 
     Runs on the shared drill fleet AFTER the corrupt drill, so counter
     asserts are written against deltas/cumulative values and the log
-    postmortem is bounded to this drill's time window."""
+    postmortem is bounded to this drill's time window.
+
+    ISSUE 20 additions: the kill goes through the chaos plumbing
+    (``inject("kill:1")`` → a ``chaos.injected`` self-label on the
+    fleet log), a fresh aggregator + incident manager scores the drill
+    — exactly one incident, availability page as member, the injected
+    op named first cause — and after the fleet is torn down the
+    offline ``analyze incident`` CLI reconstructs the same timeline
+    from the logs alone, event for event."""
+    import urllib.request
+
+    from mpi4dl_tpu.fleet.chaos import inject, parse_chaos_spec
     from mpi4dl_tpu.serve.loadgen import run_closed_loop
+    from mpi4dl_tpu.telemetry.federation import FederatedAggregator
 
     router, sup, tele = live_fleet.router, live_fleet.sup, live_fleet.tele
     n_requests = 400
     t_floor = time.time()  # postmortem window: this drill only
+    # The drill's scorer: its own aggregator + incident manager (the
+    # corrupt drill's was closed with its test). The evidence floor
+    # pins this incident's window to THIS drill — the corrupt drill's
+    # chaos op, minutes old on the same log, must not be re-blamed.
+    agg = FederatedAggregator(replicas={
+        s.name: f"http://127.0.0.1:{s.ports['metrics_port']}"
+        for s in (sup.slot_by_index(0), sup.slot_by_index(1))
+    }, events=telemetry.JsonlWriter(tele, filename="incidents-kill.jsonl"))
+    agg.incidents.evidence_floor_ts = t_floor
+    stop_scrape = threading.Event()
+
+    def scrape_loop():
+        while not stop_scrape.is_set():
+            agg.scrape_once()
+            time.sleep(0.02)
+
+    scraper = threading.Thread(target=scrape_loop)
+    live_pm = None
     try:
+        scraper.start()
         base_served = router.stats()["served"]
 
         report = {}
@@ -1762,7 +1914,8 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(live_fleet):
         t = threading.Thread(target=load)
         t.start()
         # Deterministic mid-flight kill: wait for real traffic, then
-        # SIGKILL replica 1 while requests are queued and in flight.
+        # kill -9 replica 1 (via the chaos plumbing, so the injection
+        # self-labels on the log) while requests are in flight.
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             if router.stats()["served"] >= base_served + 40:
@@ -1770,7 +1923,9 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(live_fleet):
             time.sleep(0.01)
         victim = sup.slot_by_index(1)
         victim_pid = victim.pid
-        os.kill(victim_pid, signal.SIGKILL)
+        record = inject(parse_chaos_spec("kill:1"), sup)
+        assert record["pid"] == victim_pid
+        assert record["replica"] == "r1"
         t.join(timeout=300)
         assert not t.is_alive(), "load run wedged"
 
@@ -1800,7 +1955,50 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(live_fleet):
         assert sup.registry.get("fleet_replica_restarts_total").value(
             replica="r1", reason="exit"
         ) >= 1
+
+        # -- incident engine: the availability page opened exactly one
+        # incident; swap the dead target to the respawned replica and
+        # the page resolves → the incident closes with its postmortem.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if agg.incidents.opened_total >= 1:
+                break
+            time.sleep(0.02)
+        assert agg.incidents.opened_total == 1, agg.incidents.state()
+        agg.add_replica(
+            "r1",
+            f"http://127.0.0.1:"
+            f"{sup.slot_by_index(1).ports['metrics_port']}",
+        )
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if agg.incidents.closed_total >= 1:
+                break
+            time.sleep(0.02)
+        assert agg.incidents.closed_total == 1, agg.incidents.state()
+        assert agg.incidents.opened_total == 1
+
+        srv = agg.serve(port=0)
+        incidentz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/incidentz", timeout=10
+        ).read())
+        assert incidentz["counts"] == {"opened": 1, "closed": 1}
+        live_pm = incidentz["closed"][-1]
+        assert "replica_unreachable" in live_pm["incident"]["members"]
+        cause = live_pm["first_cause"]
+        assert cause["event"] == "chaos.injected", live_pm["timeline"]
+        assert cause["attrs"]["op"].startswith("kill:r1")
+        assert cause["attrs"]["pid"] == victim_pid
+        # The floor did its job: the corrupt drill's earlier op is off
+        # this timeline entirely.
+        chaos_on_tl = [
+            e for e in live_pm["timeline"] if e["name"] == "chaos.injected"
+        ]
+        assert len(chaos_on_tl) == 1
     finally:
+        stop_scrape.set()
+        scraper.join(timeout=10)
+        agg.close()
         live_fleet.close()
 
     # Postmortem over the flushed logs (workers SIGTERMed + router
@@ -1845,4 +2043,37 @@ def test_fleet_chaos_drill_kill_replica_mid_flight(live_fleet):
     span_names = {e["name"] for e in xs}
     assert any(n.startswith("rpc_") for n in span_names)  # both hops
     assert {"queue_wait", "device_compute"} <= span_names  # survivor
+
+    # Offline auto-postmortem: with the fleet GONE, the analyze CLI
+    # rebuilds both drills' incidents from the logs alone, and the kill
+    # incident's timeline matches what /incidentz served live, event
+    # for event (same pure builders over the same flushed files).
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analyze", "incident",
+         tele, "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH":
+             REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+    )
+    assert r.returncode == 0, r.stderr
+    postmortems = json.loads(r.stdout)
+    assert len(postmortems) == 2  # corrupt drill's + this one
+    off_pm = [
+        p for p in postmortems
+        if p["incident"]["id"] == live_pm["incident"]["id"]
+    ]
+    assert len(off_pm) == 1
+    off_pm = off_pm[0]
+    assert off_pm["timeline"] == live_pm["timeline"]
+    assert off_pm["first_cause"] == live_pm["first_cause"]
+    assert off_pm["incident"]["mttr_s"] == pytest.approx(
+        live_pm["incident"]["mttr_s"]
+    )
+    # Blame accuracy across the drill set: every reconstructed incident
+    # names ITS injected chaos op — corrupt blamed corrupt, kill kill.
+    blamed = sorted(
+        p["first_cause"]["attrs"]["op"].split(":")[0] for p in postmortems
+    )
+    assert blamed == ["corrupt", "kill"]
     assert len({e["pid"] for e in xs}) >= 2  # client+router pid, engine pid

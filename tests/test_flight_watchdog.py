@@ -66,6 +66,40 @@ def test_flight_dump_is_schema_valid_jsonl(tmp_path):
     assert reg.get("flight_recorder_dumps_total").value(reason="manual") == 1
 
 
+def test_flight_dump_refiles_under_open_incident(tmp_path):
+    """Satellite (ISSUE 20): a dump fired while an incident is open is
+    refiled under ``reason="incident"`` — the marker (and filename)
+    carries the incident id plus the ORIGINAL trigger, so the close
+    event's dump list links it and nothing about why it fired is
+    lost. With no open incident the provider is a no-op."""
+    reg = telemetry.MetricsRegistry()
+    incident_id = []
+    fr = telemetry.FlightRecorder(
+        capacity=32, registry=reg, directory=str(tmp_path),
+        incident=lambda: incident_id[0] if incident_id else None,
+    )
+    fr.record(_marker(0))
+    path = fr.dump(reason="watchdog")
+    assert path.endswith("-watchdog.jsonl")  # closed: untouched
+
+    incident_id.append("inc-abc-123")
+    path = fr.dump(reason="watchdog")
+    assert path.endswith("-incident.jsonl")
+    marker = telemetry.read_events(path)[-1]
+    assert marker["name"] == "flight.dump"
+    assert marker["attrs"]["reason"] == "incident"
+    assert marker["attrs"]["trigger"] == "watchdog"
+    assert marker["attrs"]["incident"] == "inc-abc-123"
+    assert reg.get("flight_recorder_dumps_total").value(
+        reason="incident"
+    ) == 1
+    # A broken provider must never lose the dump itself.
+    fr.incident = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    path = fr.dump(reason="crash")
+    assert path.endswith("-crash.jsonl")
+    assert telemetry.read_events(path)[-1]["attrs"]["reason"] == "crash"
+
+
 def test_flight_sigterm_dump_chains_previous_handler(tmp_path):
     fr = telemetry.FlightRecorder(capacity=8, directory=str(tmp_path))
     fr.record(_marker(0))

@@ -1,0 +1,454 @@
+"""Incident engine unit tests (telemetry/incident.py): causal timeline
+ordering, the first-cause rule table, blast-radius absent-not-zero,
+the alert-driven open/fold/close lifecycle, cataloged metrics, the
+postmortem artifact, and live-vs-reconstructed equality.
+
+The tier-1 fleet drills (test_fleet.py) exercise the same engine
+against real killed subprocesses; everything here is pure/in-process.
+"""
+
+import json
+import os
+
+import pytest
+
+from mpi4dl_tpu import telemetry
+from mpi4dl_tpu.telemetry.incident import (
+    EVIDENCE_EVENTS,
+    IncidentManager,
+    blast_radius,
+    build_postmortem,
+    build_timeline,
+    collect_events,
+    first_cause,
+    reconstruct_incidents,
+)
+
+
+def _ev(ts, name, **attrs):
+    return {"ts": ts, "kind": "event", "name": name, "attrs": attrs}
+
+
+# -- timeline -----------------------------------------------------------------
+
+
+def test_timeline_orders_causes_before_symptoms_at_equal_ts():
+    # A chaos op and the page it trips can share a coarse timestamp;
+    # the cause must still order first (EVIDENCE_EVENTS rank).
+    events = [
+        _ev(10.0, "alert.transition", alert="replica_unreachable",
+            **{"from": "resolved", "to": "firing"}),
+        _ev(10.0, "chaos.injected", op="kill:r1@+1s"),
+        _ev(10.0, "elastic.restart", replica="r1", reason="death"),
+    ]
+    tl = build_timeline(events, 0.0, 20.0)
+    assert [e["name"] for e in tl] == [
+        "chaos.injected", "elastic.restart", "alert.transition",
+    ]
+
+
+def test_timeline_windows_and_filters_non_evidence():
+    events = [
+        _ev(1.0, "chaos.injected", op="early"),       # before window
+        _ev(5.0, "oom.report", program="train_step"),
+        _ev(6.0, "incident.open", id="inc-1"),        # lifecycle ≠ evidence
+        _ev(7.0, "heartbeat"),                        # unknown name
+        _ev(50.0, "tail.sample", trace_id="t-1"),     # after window
+    ]
+    tl = build_timeline(events, 4.0, 10.0)
+    assert [e["name"] for e in tl] == ["oom.report"]
+    assert all(e["name"] in EVIDENCE_EVENTS for e in tl)
+
+
+def test_timeline_spans_anchor_across_skewed_wall_clocks():
+    # Span A is EMITTED later (larger ts) but STARTED first once its
+    # monotonic duration is rebased onto the wall clock — the
+    # cross-pid alignment trace-export uses. Plain-event order is
+    # untouched.
+    span_a = {
+        "ts": 100.0, "kind": "span", "name": "request", "trace_id": "t-a",
+        "spans": [{"phase": "queue", "start_s": 0.0, "end_s": 2.0},
+                  {"phase": "compute", "start_s": 2.0, "end_s": 6.0}],
+    }
+    span_b = {
+        "ts": 99.0, "kind": "span", "name": "request", "trace_id": "t-b",
+        "spans": [{"phase": "compute", "start_s": 0.0, "end_s": 1.0}],
+    }
+    tl = build_timeline([span_b, span_a], 90.0, 110.0, include_spans=True)
+    assert [e["trace_id"] for e in tl] == ["t-a", "t-b"]  # 94.0 < 98.0
+    assert tl[0]["ts"] == pytest.approx(94.0)
+    assert tl[0]["phases"] == ["queue", "compute"]
+    assert tl[0]["duration_s"] == pytest.approx(6.0)
+    # Without include_spans the same call is events-only.
+    assert build_timeline([span_b, span_a], 90.0, 110.0) == []
+
+
+# -- first-cause rule table ---------------------------------------------------
+
+
+def test_first_cause_priority_beats_timestamp_order():
+    # oom.report outranks elastic.restart even when the restart is
+    # earlier on the wall clock — rule priority, then earliest event.
+    tl = build_timeline([
+        _ev(5.0, "elastic.restart", replica="r1", reason="death"),
+        _ev(6.0, "oom.report", program="conv_fwd"),
+    ], 0.0, 10.0)
+    cause = first_cause(tl, {"replica_unreachable"})
+    assert cause["event"] == "oom.report"
+    assert cause["label"] == "out-of-memory in conv_fwd"
+    assert cause["rule"] == "oom.report"
+
+
+def test_first_cause_chaos_beats_everything_and_takes_earliest():
+    tl = build_timeline([
+        _ev(3.0, "chaos.injected", op="kill:1"),
+        _ev(4.0, "chaos.injected", op="corrupt:0"),
+        _ev(2.0, "oom.report", program="x"),
+    ], 0.0, 10.0)
+    cause = first_cause(tl, {"replica_unreachable"})
+    assert cause["event"] == "chaos.injected"
+    assert cause["ts"] == pytest.approx(3.0)
+    assert cause["label"] == "injected chaos op kill:1"
+
+
+def test_first_cause_canary_rule_gated_on_numerics_page():
+    events = [
+        _ev(1.0, "canary.failure", check="digest"),
+        _ev(2.0, "alert.transition", alert="replica_unreachable",
+            **{"from": "resolved", "to": "firing"}),
+    ]
+    tl = build_timeline(events, 0.0, 10.0)
+    # An availability page is NOT explained by a canary failure …
+    cause = first_cause(tl, {"replica_unreachable"})
+    assert cause["event"] == "alert.transition"
+    assert "first firing page replica_unreachable" in cause["label"]
+    # … but a numerics page is.
+    cause = first_cause(tl, {"numerics_divergence"})
+    assert cause["event"] == "canary.failure"
+    assert cause["label"] == "numerics canary failure (digest)"
+
+
+def test_first_cause_fallback_requires_member_firing_transition():
+    tl = build_timeline([
+        _ev(1.0, "alert.transition", alert="other_alert",
+            **{"from": "resolved", "to": "firing"}),
+        _ev(2.0, "alert.transition", alert="latency_p99",
+            **{"from": "firing", "to": "resolved"}),
+    ], 0.0, 10.0)
+    assert first_cause(tl, {"latency_p99"}) is None
+    assert first_cause([], {"latency_p99"}) is None
+
+
+# -- blast radius -------------------------------------------------------------
+
+
+def test_blast_radius_absent_not_zero_without_metrics_snapshots():
+    events = [
+        _ev(5.0, "tail.sample", trace_id="t-1", tenant="acme"),
+        _ev(6.0, "tail.sample", trace_id="t-2"),
+    ]
+    blast = blast_radius(events, 0.0, 10.0)
+    assert blast["n_traces"] == 2
+    assert blast["trace_ids"] == ["t-1", "t-2"]
+    assert blast["tenants"] == ["acme"]
+    # No metrics snapshots in the window → unknown, NOT zero.
+    assert blast["requeues"] is None
+    assert blast["sheds"] is None
+    assert blast["slo_budget_burned"] is None
+
+
+def _metrics_snapshot(ts, requeues, budget, exemplar=None):
+    lat = {"series": [{
+        "labels": {}, "value": 1,
+        "exemplars": {"0.1": {"trace_id": exemplar}} if exemplar else {},
+    }]}
+    return {
+        "ts": ts, "kind": "metrics",
+        "metrics": {
+            "fleet_requeues_total": {
+                "series": [{"labels": {}, "value": requeues}],
+            },
+            "slo_error_budget_remaining": {
+                "series": [{"labels": {"slo": "availability"},
+                            "value": budget}],
+            },
+            "serve_latency_seconds": lat,
+        },
+    }
+
+
+def test_blast_radius_window_burn_and_exemplar_traces():
+    events = [
+        _metrics_snapshot(1.0, requeues=3, budget=0.9, exemplar="t-ex"),
+        _metrics_snapshot(9.0, requeues=10, budget=0.4),
+        _ev(5.0, "tail.sample", trace_id="t-1", tenant="acme"),
+    ]
+    blast = blast_radius(events, 0.0, 10.0)
+    assert blast["requeues"] == pytest.approx(7.0)
+    assert blast["slo_budget_burned"] == {
+        "availability": pytest.approx(0.5)
+    }
+    assert set(blast["trace_ids"]) == {"t-1", "t-ex"}
+    # A single snapshot cannot measure a burn → absent again.
+    assert blast_radius(events[:1], 0.0, 10.0)["requeues"] is None
+
+
+# -- collect_events tolerance -------------------------------------------------
+
+
+def test_collect_events_skips_garbage_and_truncated_tails(tmp_path):
+    p = tmp_path / "telemetry-1.jsonl"
+    good = _ev(1.0, "chaos.injected", op="kill:1")
+    p.write_text(
+        json.dumps(good) + "\n"
+        + "not json at all\n"
+        + '{"ts": 2.0, "kind": "event"\n'          # truncated tail
+        + '{"kind": "event", "name": "x"}\n'       # schema-invalid (no ts)
+    )
+    (tmp_path / "notes.txt").write_text("ignored: not .jsonl\n")
+    events = collect_events([str(tmp_path)])
+    assert len(events) == 1
+    assert events[0]["name"] == "chaos.injected"
+
+
+# -- manager lifecycle --------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _AlertSurface:
+    """Scripted /alertz payload: set .firing to the currently-firing
+    page names; transitions accumulate like the aggregator's."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.firing = []
+        self.transitions = []
+
+    def fire(self, name):
+        self.firing.append(name)
+        self.transitions.append(_ev(
+            self.clock(), "alert.transition", alert=name, severity="page",
+            **{"from": "resolved", "to": "firing"},
+        ))
+
+    def resolve(self, name):
+        self.firing.remove(name)
+
+    def __call__(self):
+        return {
+            "alerts": [
+                {"name": n, "severity": "page", "state": "firing"}
+                for n in self.firing
+            ] + [{"name": "advisory_thing", "severity": "ticket",
+                  "state": "firing"}],
+            "transitions": self.transitions,
+        }
+
+
+@pytest.fixture()
+def manager(tmp_path):
+    clock = _Clock()
+    surface = _AlertSurface(clock)
+    writer = telemetry.JsonlWriter(str(tmp_path))
+    reg = telemetry.MetricsRegistry()
+    mgr = IncidentManager(
+        surface, registry=reg, events=writer,
+        telemetry_dir=str(tmp_path), wall_clock=clock,
+    )
+    yield mgr, surface, clock, reg, tmp_path
+    writer.close()
+
+
+def test_manager_opens_folds_and_closes(manager):
+    mgr, surface, clock, reg, tmp_path = manager
+    mgr.step()
+    assert mgr.open_incident is None  # ticket-severity never pages
+
+    clock.t = 1010.0
+    surface.fire("replica_unreachable")
+    clock.t = 1012.5
+    mgr.step()
+    inc = mgr.open_incident
+    assert inc is not None and mgr.opened_total == 1
+    assert inc["opened_by"] == "replica_unreachable"
+    # MTTA = open wall time − the page's firing transition timestamp.
+    assert inc["mtta_s"] == pytest.approx(2.5)
+    assert mgr.open_incident_id() == inc["id"]
+    assert reg.get("incident_open").value() == 1.0
+    assert reg.get("incidents_total").value(state="opened") == 1
+
+    # A second page fires while open: FOLDS into the same incident.
+    clock.t = 1015.0
+    surface.fire("latency_p99_burn")
+    mgr.step()
+    assert mgr.opened_total == 1
+    assert set(mgr.open_incident["members"]) == {
+        "replica_unreachable", "latency_p99_burn",
+    }
+
+    # Close only when EVERY member has resolved.
+    clock.t = 1020.0
+    surface.resolve("replica_unreachable")
+    mgr.step()
+    assert mgr.open_incident is not None
+    clock.t = 1030.0
+    surface.resolve("latency_p99_burn")
+    mgr.step()
+    assert mgr.open_incident is None and mgr.closed_total == 1
+    closed = mgr.closed[-1]
+    assert closed["mttr_s"] == pytest.approx(1030.0 - 1012.5)
+    assert reg.get("incident_open").value() == 0.0
+    assert reg.get("incidents_total").value(state="closed") == 1
+    assert reg.get("incident_mttr_seconds").value() == pytest.approx(17.5)
+
+    # Members re-firing while open clear their resolved mark.
+    m = closed["members"]["replica_unreachable"]
+    assert m["resolved_ts"] == pytest.approx(1020.0)
+
+
+def test_manager_lifecycle_events_schema_valid_and_reconstructible(manager):
+    mgr, surface, clock, reg, tmp_path = manager
+    surface.fire("replica_unreachable")
+    clock.t = 1001.0
+    mgr.step()
+    clock.t = 1002.0
+    surface.fire("numerics_divergence")
+    mgr.step()
+    clock.t = 1005.0
+    surface.resolve("replica_unreachable")
+    surface.resolve("numerics_divergence")
+    mgr.step()
+
+    events = collect_events([str(tmp_path)])
+    names = [e["name"] for e in events if e["name"].startswith("incident.")]
+    assert names == ["incident.open", "incident.update", "incident.close"]
+    for e in events:
+        telemetry.validate_event(e)  # schema-valid end to end
+
+    # The offline reconstruction equals the live closed record on every
+    # field the lifecycle events carry.
+    recs = reconstruct_incidents(events)
+    assert len(recs) == 1
+    rec, live = recs[0], mgr.closed[-1]
+    assert rec["id"] == live["id"]
+    assert rec["state"] == "closed"
+    assert rec["opened_ts"] == pytest.approx(live["opened_ts"])
+    assert rec["closed_ts"] == pytest.approx(live["closed_ts"])
+    assert rec["mtta_s"] == pytest.approx(live["mtta_s"])
+    assert rec["mttr_s"] == pytest.approx(live["mttr_s"])
+    assert set(rec["members"]) == set(live["members"])
+    for n, m in rec["members"].items():
+        assert m["first_firing_ts"] == pytest.approx(
+            live["members"][n]["first_firing_ts"]
+        )
+
+    # …and the postmortems built from the two records match event for
+    # event (same pure builders over the same files).
+    pm_live = build_postmortem(live, events)
+    pm_rec = build_postmortem(rec, events)
+    assert pm_rec["timeline"] == pm_live["timeline"]
+    assert pm_rec["first_cause"] == pm_live["first_cause"]
+
+
+def test_manager_writes_postmortem_artifact_and_blames_chaos(manager):
+    mgr, surface, clock, reg, tmp_path = manager
+    # The cause lands on the log BEFORE the page (the chaos module's
+    # contract), inside the lookback window.
+    mgr.events.write(_ev(
+        clock() - 1.0, "chaos.injected", op="kill:1", action="kill",
+        target="r1", pid=1234,
+    ))
+    surface.fire("replica_unreachable")
+    clock.t = 1003.0
+    mgr.step()
+    clock.t = 1008.0
+    surface.resolve("replica_unreachable")
+    mgr.step()
+
+    # incident.close names the first cause and links the artifact.
+    close = [
+        e for e in collect_events([str(tmp_path)])
+        if e["name"] == "incident.close"
+    ][0]
+    assert close["attrs"]["first_cause"]["event"] == "chaos.injected"
+    assert close["attrs"]["first_cause"]["label"] == (
+        "injected chaos op kill:1"
+    )
+    path = close["attrs"]["postmortem"]
+    assert path and os.path.exists(path)
+    pm = json.load(open(path))
+    assert pm["incident"]["id"] == close["attrs"]["id"]
+    assert pm["first_cause"]["event"] == "chaos.injected"
+    # The artifact is .json, NOT .jsonl: a rescan must not re-read it.
+    assert path.endswith(".json") and not path.endswith(".jsonl")
+
+
+def test_evidence_floor_prevents_reblaming_prior_incident(manager):
+    """Back-to-back faults within one lookback window: the second
+    incident's evidence window starts at the first's close, so the
+    first drill's chaos op is never re-blamed for the second page —
+    live and offline alike (the floor travels in incident.open)."""
+    mgr, surface, clock, reg, tmp_path = manager
+    mgr.events.write(_ev(999.0, "chaos.injected", op="corrupt:r1"))
+    surface.fire("numerics_divergence")
+    clock.t = 1001.0
+    mgr.step()
+    clock.t = 1005.0
+    surface.resolve("numerics_divergence")
+    mgr.step()
+    assert mgr.evidence_floor_ts == pytest.approx(1005.0)
+
+    mgr.events.write(_ev(1010.0, "chaos.injected", op="kill:r1"))
+    clock.t = 1011.0
+    surface.fire("replica_unreachable")
+    mgr.step()
+    clock.t = 1015.0
+    surface.resolve("replica_unreachable")
+    mgr.step()
+
+    first, second = mgr.closed
+    events = collect_events([str(tmp_path)])
+    pm1 = build_postmortem(first, events)
+    pm2 = build_postmortem(second, events)
+    assert pm1["first_cause"]["label"] == "injected chaos op corrupt:r1"
+    assert pm2["first_cause"]["label"] == "injected chaos op kill:r1"
+    # Offline agrees: the floor is carried by incident.open.
+    recs = reconstruct_incidents(events)
+    assert recs[1]["evidence_floor_ts"] == pytest.approx(1005.0)
+    pm2_off = build_postmortem(recs[1], events)
+    assert pm2_off["first_cause"]["label"] == "injected chaos op kill:r1"
+    assert pm2_off["timeline"] == pm2["timeline"]
+
+
+def test_manager_state_is_incidentz_payload(manager):
+    mgr, surface, clock, reg, tmp_path = manager
+    surface.fire("replica_unreachable")
+    mgr.step()
+    st = mgr.state()
+    assert st["counts"] == {"opened": 1, "closed": 0}
+    assert len(st["open"]) == 1 and st["closed"] == []
+    assert st["open"][0]["incident"]["state"] == "open"
+    assert st["severities"] == ["page"]
+    surface.resolve("replica_unreachable")
+    clock.t += 5.0
+    mgr.step()
+    st = mgr.state()
+    assert st["counts"] == {"opened": 1, "closed": 1}
+    assert st["open"] == [] and len(st["closed"]) == 1
+    assert json.dumps(st)  # JSON-serializable for the HTTP endpoint
+
+
+def test_manager_survives_broken_alert_surface(tmp_path):
+    def boom():
+        raise RuntimeError("scrape exploded")
+
+    mgr = IncidentManager(boom, telemetry_dir=str(tmp_path))
+    mgr.step()  # must not raise
+    assert mgr.open_incident is None and mgr.opened_total == 0
